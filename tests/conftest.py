@@ -1,0 +1,42 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-device sharding tests run without TPU hardware via
+``--xla_force_host_platform_device_count`` (the TPU answer to testing multi-chip
+topologies on one host). Env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+SAMPLE_VIDEO = "/root/reference/sample/v_GGSY1Qvo990.mp4"
+SAMPLE_VIDEO_2 = "/root/reference/sample/v_ZNVhz7ctTq0.mp4"
+
+
+@pytest.fixture(scope="session")
+def sample_video():
+    if not os.path.exists(SAMPLE_VIDEO):
+        pytest.skip("sample video unavailable")
+    return SAMPLE_VIDEO
+
+
+@pytest.fixture(scope="session")
+def sample_video_2():
+    if not os.path.exists(SAMPLE_VIDEO_2):
+        pytest.skip("sample video unavailable")
+    return SAMPLE_VIDEO_2
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,69 @@
+"""Config dataclass + CLI shim behavior (reference flag surface)."""
+
+import pytest
+
+from video_features_tpu.cli import parse_args
+from video_features_tpu.config import ExtractionConfig, resolve_model_defaults
+
+
+def test_i3d_defaults():
+    cfg = resolve_model_defaults(ExtractionConfig(feature_type="i3d"))
+    assert cfg.stack_size == 64 and cfg.step_size == 64
+    assert cfg.streams == ("rgb", "flow")
+
+
+def test_r21d_defaults():
+    cfg = resolve_model_defaults(ExtractionConfig(feature_type="r21d_rgb"))
+    assert cfg.stack_size == 16 and cfg.step_size == 16
+
+
+def test_user_override_kept():
+    cfg = resolve_model_defaults(ExtractionConfig(feature_type="i3d", stack_size=24, step_size=8))
+    assert cfg.stack_size == 24 and cfg.step_size == 8
+
+
+def test_same_out_tmp_rejected():
+    cfg = ExtractionConfig(feature_type="i3d", output_path="./x", tmp_path="./x")
+    with pytest.raises(ValueError, match="same path"):
+        cfg.validate()
+
+
+def test_r21d_fps_rejected():
+    cfg = ExtractionConfig(feature_type="r21d_rgb", extraction_fps=5)
+    with pytest.raises(ValueError, match="original fps"):
+        cfg.validate()
+
+
+def test_cli_parse_reference_flags():
+    cfg = parse_args([
+        "--feature_type", "i3d",
+        "--video_paths", "a.mp4", "b.mp4",
+        "--stack_size", "24",
+        "--step_size", "24",
+        "--flow_type", "raft",
+        "--on_extraction", "save_numpy",
+    ])
+    assert cfg.feature_type == "i3d"
+    assert cfg.video_paths == ("a.mp4", "b.mp4")
+    assert cfg.stack_size == 24
+    assert cfg.flow_type == "raft"
+    assert cfg.on_extraction == "save_numpy"
+
+
+def test_cli_device_ids_maps_to_num_devices():
+    cfg = parse_args(["--feature_type", "resnet50", "--video_paths", "a.mp4",
+                      "--device_ids", "0", "1", "2"])
+    assert cfg.num_devices == 3
+
+
+def test_cli_show_pred_forces_one_device():
+    cfg = parse_args(["--feature_type", "resnet50", "--video_paths", "a.mp4",
+                      "--device_ids", "0", "1", "--show_pred"])
+    assert cfg.num_devices == 1
+
+
+def test_cli_larger_edge_flag():
+    cfg = parse_args(["--feature_type", "raft", "--video_paths", "a.mp4",
+                      "--resize_to_larger_edge", "--side_size", "256"])
+    assert cfg.resize_to_smaller_edge is False
+    assert cfg.side_size == 256

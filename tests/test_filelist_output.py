@@ -1,0 +1,85 @@
+"""File-list forming/sharding and output actions."""
+
+import os
+
+import numpy as np
+import pytest
+
+from video_features_tpu.io.filelist import form_video_list, shard_round_robin, write_shard_files
+from video_features_tpu.io.output import (
+    action_on_extraction,
+    feature_output_dir,
+    load_done_set,
+    mark_done,
+)
+
+
+def test_form_video_list_from_file(tmp_path):
+    f = tmp_path / "paths.txt"
+    f.write_text("a.mp4\n\nb.mp4\n")
+    out = form_video_list(file_with_video_paths=str(f), warn_missing=False)
+    assert out == ["a.mp4", "b.mp4"]
+
+
+def test_form_video_list_explicit():
+    assert form_video_list(["x.mp4", "y.mp4"], warn_missing=False) == ["x.mp4", "y.mp4"]
+
+
+def test_file_wins_over_explicit(tmp_path):
+    f = tmp_path / "paths.txt"
+    f.write_text("a.mp4\n")
+    out = form_video_list(["z.mp4"], file_with_video_paths=str(f), warn_missing=False)
+    assert out == ["a.mp4"]
+
+
+def test_shard_round_robin():
+    paths = [f"v{i}.mp4" for i in range(7)]
+    shards = [shard_round_robin(paths, k, 3) for k in range(3)]
+    assert shards[0] == ["v0.mp4", "v3.mp4", "v6.mp4"]
+    assert shards[1] == ["v1.mp4", "v4.mp4"]
+    assert shards[2] == ["v2.mp4", "v5.mp4"]
+    # partition property
+    assert sorted(sum(shards, [])) == sorted(paths)
+
+
+def test_write_shard_files(tmp_path):
+    vdir = tmp_path / "videos"
+    vdir.mkdir()
+    for i in range(5):
+        (vdir / f"v{i}.mp4").touch()
+    out = write_shard_files(str(vdir), str(tmp_path / "lists"), 2)
+    assert len(out) == 2
+    lines0 = open(out[0]).read().splitlines()
+    lines1 = open(out[1]).read().splitlines()
+    assert len(lines0) == 3 and len(lines1) == 2
+
+
+def test_save_numpy_naming(tmp_path):
+    feats = {"rgb": np.ones((2, 4), np.float32), "fps": np.array(25.0)}
+    out_dir = feature_output_dir(str(tmp_path / "out"), "i3d")
+    saved = action_on_extraction(feats, "/data/my_video.mp4", out_dir, "save_numpy")
+    assert set(saved) == {"rgb", "fps"}
+    assert saved["rgb"].endswith(os.path.join("out", "i3d", "my_video_rgb.npy"))
+    np.testing.assert_array_equal(np.load(saved["rgb"]), feats["rgb"])
+
+
+def test_print_action(capsys):
+    feats = {"rgb": np.arange(4, dtype=np.float32)}
+    action_on_extraction(feats, "v.mp4", ".", "print")
+    out = capsys.readouterr().out
+    assert "rgb" in out
+    assert "max: 3.00000000; mean: 1.50000000; min: 0.00000000" in out
+
+
+def test_unknown_action():
+    with pytest.raises(NotImplementedError):
+        action_on_extraction({"a": np.zeros(1)}, "v.mp4", ".", "save_pickle")
+
+
+def test_done_manifest(tmp_path):
+    out = str(tmp_path)
+    assert load_done_set(out) == set()
+    mark_done(out, "a.mp4", ["rgb"])
+    mark_done(out, "b.mp4", ["rgb", "flow"])
+    done = load_done_set(out)
+    assert os.path.abspath("a.mp4") in done and os.path.abspath("b.mp4") in done

@@ -1,0 +1,58 @@
+"""Decoder behavior on the real sample videos."""
+
+import itertools
+
+import numpy as np
+
+from video_features_tpu.io.video import decode_all, open_video, probe_video
+from video_features_tpu.ops.image import edge_resize_size, pil_edge_resize
+
+
+def test_probe(sample_video):
+    meta = probe_video(sample_video)
+    assert meta.width == 320 and meta.height == 240
+    assert abs(meta.fps - 19.62) < 0.01
+    assert meta.frame_count == 355
+
+
+def test_decode_first_frames(sample_video):
+    meta, frames = open_video(sample_video)
+    first = list(itertools.islice(frames, 3))
+    assert len(first) == 3
+    rgb, pos = first[0]
+    assert rgb.shape == (240, 320, 3) and rgb.dtype == np.uint8
+    assert pos >= 0.0 and first[1][1] > first[0][1]  # monotone POS_MSEC
+
+
+def test_decode_all_counts(sample_video):
+    meta, frames, ts = decode_all(sample_video)
+    assert frames.shape == (355, 240, 320, 3)
+    assert ts.shape == (355,)
+    assert np.all(np.diff(ts) > 0)
+
+
+def test_native_fps_resampling(sample_video):
+    meta, frames, ts = decode_all(sample_video, extraction_fps=10, use_ffmpeg="never")
+    # 355 frames @19.62fps ≈ 18.1s → ~181 frames at 10fps
+    assert meta.fps == 10.0
+    assert 178 <= len(frames) <= 184
+    assert np.allclose(np.diff(ts), 100.0)
+
+
+def test_transform_applied(sample_video):
+    meta, frames = open_video(sample_video, transform=lambda f: pil_edge_resize(f, 64))
+    rgb, _ = next(iter(frames))
+    # 240x320: smaller edge (h) → 64, w = int(64 * 320 / 240) = 85
+    assert rgb.shape == (64, 85, 3)
+
+
+def test_edge_resize_size_semantics():
+    # smaller edge
+    assert edge_resize_size(320, 240, 256, True) == (341, 256)
+    assert edge_resize_size(240, 320, 256, True) == (256, 341)
+    # larger edge
+    assert edge_resize_size(320, 240, 256, False) == (256, 192)
+    # no-op when the matched edge already equals size
+    assert edge_resize_size(256, 300, 256, True) == (256, 300)
+    # int truncation (not round): 320*100/240 = 133.33 → 133
+    assert edge_resize_size(320, 240, 100, True) == (133, 100)

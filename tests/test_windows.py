@@ -1,0 +1,87 @@
+"""Window/slice math vs hand-computed values and reference semantics."""
+
+import numpy as np
+import pytest
+
+from video_features_tpu.utils.windows import (
+    flow_stack_plan,
+    form_slices,
+    frame_batch_plan,
+    pair_batch_plan,
+    slice_starts,
+)
+
+
+def test_form_slices_exact_fit():
+    # 100 frames, stack 15, step 15 → 6 full stacks ending at 90 (reference docstring example)
+    assert form_slices(100, 15, 15) == [
+        (0, 15), (15, 30), (30, 45), (45, 60), (60, 75), (75, 90)
+    ]
+
+
+def test_form_slices_overlap():
+    assert form_slices(10, 4, 2) == [(0, 4), (2, 6), (4, 8), (6, 10)]
+
+
+def test_form_slices_short_video():
+    assert form_slices(3, 16, 16) == []
+
+
+def test_form_slices_single():
+    assert form_slices(16, 16, 16) == [(0, 16)]
+
+
+def test_slice_starts_dtype():
+    s = slice_starts(100, 15, 15)
+    assert s.dtype == np.int32
+    assert s.tolist() == [0, 15, 30, 45, 60, 75]
+
+
+def test_flow_stack_plan_needs_extra_frame():
+    # 65 frames exactly fills one 64-stack (64 pairs need 65 frames)
+    assert flow_stack_plan(65, 64, 64).tolist() == [0]
+    # 64 frames: not enough
+    assert flow_stack_plan(64, 64, 64).tolist() == []
+    # 130 frames: stacks at 0 and 64 (needs frame 128 inclusive)
+    assert flow_stack_plan(130, 64, 64).tolist() == [0, 64]
+
+
+def test_flow_stack_plan_overlapping_steps():
+    # step < stack keeps overlap, mirroring stack = stack[step:] in the reference loop
+    assert flow_stack_plan(11, 4, 2).tolist() == [0, 2, 4, 6]
+
+
+def test_pair_batch_plan_reference_carry():
+    # 10 frames, batch 4: reference runs on 5 frames (4 pairs), carries the last
+    # → ranges (0,4), (4,8), final partial (8,9)
+    assert pair_batch_plan(10, 4) == [(0, 4), (4, 8), (8, 9)]
+    # exact fit: 9 frames, batch 4 → (0,4), (4,8) and no partial
+    assert pair_batch_plan(9, 4) == [(0, 4), (4, 8)]
+    # single frame: no pairs
+    assert pair_batch_plan(1, 4) == []
+    # two frames: one pair
+    assert pair_batch_plan(2, 4) == [(0, 1)]
+
+
+def test_pair_batch_plan_covers_all_pairs():
+    for n in range(2, 40):
+        for b in (1, 3, 7):
+            ranges = pair_batch_plan(n, b)
+            total = sum(e - s for s, e in ranges)
+            assert total == n - 1
+            # contiguity with carry
+            for (s1, e1), (s2, e2) in zip(ranges, ranges[1:]):
+                assert e1 == s2
+
+
+def test_frame_batch_plan():
+    assert frame_batch_plan(10, 4) == [(0, 4), (4, 8), (8, 10)]
+    assert frame_batch_plan(4, 4) == [(0, 4)]
+    assert frame_batch_plan(0, 4) == []
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        form_slices(10, 0, 1)
+    with pytest.raises(ValueError):
+        pair_batch_plan(10, 0)

@@ -1,0 +1,25 @@
+"""tpu-video-features: a TPU-native (JAX/XLA/Flax/Pallas) video feature extraction framework.
+
+Capabilities mirror the reference toolkit `yhZhai/video_features` (see SURVEY.md):
+given a list of videos, extract per-clip / per-frame / per-audio-window features from one
+of six pretrained networks — I3D (rgb+flow), R(2+1)D-18, ResNet-50, RAFT, PWC-Net,
+VGGish — and print them or save them as ``.npy``.
+
+Architecture (TPU-first, not a port):
+
+- Video decode, PIL-semantics resizing and DSP run on the CPU host
+  (``video_features_tpu.io``); fixed-shape clip batches stream into HBM with async
+  prefetch (``video_features_tpu.parallel.prefetch``).
+- All model forwards are Flax modules compiled once under ``jax.jit`` with static
+  shapes (``video_features_tpu.models``); hot custom ops (PWC 9x9 cost volume, RAFT
+  correlation lookup) are Pallas kernels or pure-XLA formulations
+  (``video_features_tpu.ops``).
+- Parallelism is expressed over a ``jax.sharding.Mesh``: data-parallel clip sharding
+  over ICI, optional tensor-parallel channel sharding, and temporal context
+  parallelism with ``ppermute`` halo exchange for long videos
+  (``video_features_tpu.parallel``).
+"""
+
+__version__ = "0.1.0"
+
+from .config import FEATURE_TYPES as SUPPORTED_FEATURE_TYPES  # noqa: E402

@@ -1,0 +1,79 @@
+"""Reference-compatible command line (``/root/reference/main.py:52-84`` flag surface).
+
+``--device_ids`` (CUDA ordinals) is accepted for drop-in compatibility but maps to the
+TPU runtime's device count; new TPU-specific flags are added under the same parser.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+from .config import (
+    FEATURE_TYPES,
+    FLOW_TYPES,
+    ON_EXTRACTION,
+    STREAMS,
+    ExtractionConfig,
+    config_from_namespace,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="Extract Features (TPU-native)")
+    parser.add_argument("--feature_type", required=True, choices=list(FEATURE_TYPES))
+    parser.add_argument("--video_paths", nargs="+", help="space-separated paths to videos")
+    parser.add_argument("--file_with_video_paths", help=".txt file where each line is a path")
+    parser.add_argument("--device_ids", type=int, nargs="+",
+                        help="compat shim: length = number of TPU devices to use")
+    parser.add_argument("--tmp_path", default="./tmp",
+                        help="folder for temporary files (re-encoded videos, wav files)")
+    parser.add_argument("--keep_tmp_files", action="store_true", default=False,
+                        help="keep temp files after extraction (vggish and i3d)")
+    parser.add_argument("--on_extraction", default="print", choices=list(ON_EXTRACTION),
+                        help="what to do once the stack is extracted")
+    parser.add_argument("--output_path", default="./output", help="where to store results if saved")
+    parser.add_argument("--extraction_fps", type=int, help="do not specify for original video fps")
+    parser.add_argument("--stack_size", type=int, help="feature time span in frames")
+    parser.add_argument("--step_size", type=int, help="feature step size in frames")
+    parser.add_argument("--streams", nargs="+", choices=list(STREAMS),
+                        help="streams to use for i3d; both if not specified")
+    parser.add_argument("--flow_type", choices=list(FLOW_TYPES), default="pwc",
+                        help="flow net used in i3d. PWC is faster, RAFT more accurate.")
+    parser.add_argument("--batch_size", type=int, default=1,
+                        help="batch size for frame-wise / frame-pair extractors")
+    parser.add_argument("--resize_to_larger_edge", dest="resize_to_smaller_edge",
+                        action="store_false", default=True,
+                        help="resize the larger side to --side_size instead of the smaller")
+    parser.add_argument("--side_size", type=int,
+                        help="if specified, inputs are edge-resized to this size (raft/pwc)")
+    parser.add_argument("--show_pred", action="store_true", default=False,
+                        help="print model predictions (kinetics/imagenet top-5)")
+
+    # TPU-native flags (no reference equivalent)
+    parser.add_argument("--dtype", default="float32", choices=["float32", "bfloat16"],
+                        help="device compute dtype; float32 gives reference parity")
+    parser.add_argument("--clips_per_batch", type=int, default=1,
+                        help="clips per jitted device step (MXU utilization)")
+    parser.add_argument("--num_devices", type=int, default=None,
+                        help="devices in the data-parallel mesh (default: all local)")
+    parser.add_argument("--resume", action="store_true", default=False,
+                        help="skip videos recorded in the output done-manifest")
+    return parser
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> ExtractionConfig:
+    ns = build_parser().parse_args(argv)
+    if ns.device_ids is not None and ns.num_devices is None:
+        ns.num_devices = len(ns.device_ids)
+    if ns.show_pred:
+        # reference forces a single device for prediction printing (utils/utils.py:95-97)
+        print("You want to see predictions. So, I will use only one device.")
+        ns.num_devices = 1
+        if ns.feature_type == "vggish":
+            print("Showing class predictions is not implemented for VGGish")
+    if ns.on_extraction == "save_numpy":
+        print(f"Saving features to {ns.output_path}")
+    if ns.keep_tmp_files:
+        print(f"Keeping temp files in {ns.tmp_path}")
+    return config_from_namespace(ns)

@@ -1,0 +1,131 @@
+"""Typed configuration for extraction jobs.
+
+The reference passes a raw argparse ``Namespace`` into every extractor
+(``/root/reference/main.py:86``, ``utils/utils.py:88-105``). Here the configuration is a
+frozen dataclass: one shared ``ExtractionConfig`` covering the full reference flag
+surface (``main.py:52-84``) plus TPU-specific knobs, with per-model defaults resolved by
+``resolve_model_defaults``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+FEATURE_TYPES = ("i3d", "vggish", "r21d_rgb", "resnet50", "raft", "pwc")
+ON_EXTRACTION = ("print", "save_numpy")
+FLOW_TYPES = ("raft", "pwc")
+STREAMS = ("rgb", "flow")
+
+
+@dataclass(frozen=True)
+class ExtractionConfig:
+    """One extraction job: which model, which videos, how to run, where results go.
+
+    Field names intentionally match the reference CLI flags (``main.py:52-84``) so the
+    CLI shim is a 1:1 mapping.
+    """
+
+    feature_type: str
+    video_paths: Tuple[str, ...] = ()
+    file_with_video_paths: Optional[str] = None
+    tmp_path: str = "./tmp"
+    keep_tmp_files: bool = False
+    on_extraction: str = "print"
+    output_path: str = "./output"
+    extraction_fps: Optional[int] = None
+    stack_size: Optional[int] = None
+    step_size: Optional[int] = None
+    streams: Optional[Tuple[str, ...]] = None  # subset of ("rgb", "flow"); None = both
+    flow_type: str = "pwc"
+    batch_size: int = 1
+    resize_to_smaller_edge: bool = True
+    side_size: Optional[int] = None
+    show_pred: bool = False
+
+    # --- TPU-native knobs (no reference equivalent) ---
+    # Compute dtype for model forwards; fp32 gives bit-parity with the torch
+    # reference, bf16 maps better onto the MXU.
+    dtype: str = "float32"
+    # Clips per device step: batches sliding windows into one jit call so the MXU
+    # stays busy (the reference runs one 64-frame stack at a time).
+    clips_per_batch: int = 1
+    # Data-parallel sharding: number of devices in the mesh (None = all local).
+    num_devices: Optional[int] = None
+    # Resume: skip videos whose outputs are recorded in the done-manifest.
+    resume: bool = False
+    # Host→HBM prefetch depth (double buffering by default).
+    prefetch_depth: int = 2
+
+    def validate(self) -> None:
+        """Mirror the reference ``sanity_check`` (``utils/utils.py:88-105``)."""
+        import os
+
+        if self.feature_type not in FEATURE_TYPES:
+            raise ValueError(
+                f"unknown feature_type {self.feature_type!r}; expected one of {FEATURE_TYPES}"
+            )
+        if self.on_extraction not in ON_EXTRACTION:
+            raise ValueError(f"on_extraction must be one of {ON_EXTRACTION}")
+        if self.flow_type not in FLOW_TYPES:
+            raise ValueError(f"flow_type must be one of {FLOW_TYPES}")
+        if self.streams is not None:
+            bad = set(self.streams) - set(STREAMS)
+            if bad:
+                raise ValueError(f"unknown streams {sorted(bad)}; expected subset of {STREAMS}")
+        if os.path.relpath(self.output_path) == os.path.relpath(self.tmp_path):
+            raise ValueError("The same path for out & tmp")
+        if self.feature_type == "r21d_rgb" and self.extraction_fps is not None:
+            raise ValueError(
+                "r21d_rgb only supports extraction at the original fps; remove extraction_fps"
+            )
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if self.clips_per_batch < 1:
+            raise ValueError("clips_per_batch must be >= 1")
+
+    def replace(self, **kw) -> "ExtractionConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Per-model defaults; reference keeps these as module constants
+# (extract_i3d.py:21-29, extract_r21d.py:15-20, extract_resnet50.py:17-20).
+MODEL_DEFAULTS = {
+    "i3d": dict(stack_size=64, step_size=64),
+    "r21d_rgb": dict(stack_size=16, step_size=16),
+    "resnet50": dict(),
+    "raft": dict(),
+    "pwc": dict(),
+    "vggish": dict(),
+}
+
+
+def resolve_model_defaults(cfg: ExtractionConfig) -> ExtractionConfig:
+    """Fill in per-model stack/step defaults when the user did not override them."""
+    defaults = MODEL_DEFAULTS.get(cfg.feature_type, {})
+    updates = {k: v for k, v in defaults.items() if getattr(cfg, k) is None}
+    streams = cfg.streams
+    if cfg.feature_type == "i3d" and streams is None:
+        streams = ("rgb", "flow")
+    if streams is not None:
+        updates["streams"] = tuple(streams)
+    return cfg.replace(**updates) if updates else cfg
+
+
+def config_from_namespace(ns) -> ExtractionConfig:
+    """Build an ExtractionConfig from an argparse namespace using reference flag names."""
+    fields = {f.name for f in dataclasses.fields(ExtractionConfig)}
+    kw = {}
+    for key, value in vars(ns).items():
+        if key not in fields:
+            continue
+        if key in ("video_paths", "streams") and value is not None:
+            value = tuple(value)
+        kw[key] = value
+    if kw.get("video_paths") is None:
+        kw["video_paths"] = ()
+    cfg = ExtractionConfig(**kw)
+    cfg = resolve_model_defaults(cfg)
+    cfg.validate()
+    return cfg

@@ -1,0 +1,1 @@
+"""Host-side I/O: video decode, audio read, file lists, output actions, ffmpeg shims."""

@@ -1,0 +1,75 @@
+"""ffmpeg subprocess shims (re-encode to a target fps, mp4→wav audio extraction).
+
+Mirrors ``which_ffmpeg`` / ``reencode_video_with_diff_fps`` / ``extract_wav_from_mp4``
+(``utils/utils.py:136-201``). ffmpeg is an optional host-side dependency here: when the
+binary is absent, fps changes fall back to index-based frame sampling in the decoder
+(:mod:`video_features_tpu.io.video`), and mp4 audio extraction raises a clear error
+(wav inputs still work via scipy).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import shutil
+import subprocess
+from typing import Tuple
+
+
+def which_ffmpeg() -> str:
+    """Path to ffmpeg, or '' when not installed (reference ``utils/utils.py:136-144``)."""
+    return shutil.which("ffmpeg") or ""
+
+
+def have_ffmpeg() -> bool:
+    return which_ffmpeg() != ""
+
+
+def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: int) -> str:
+    """Re-encode ``video_path`` at ``extraction_fps`` into ``tmp_path``; return new path.
+
+    Matches ``utils/utils.py:147-169`` (same ``<stem>_new_fps.mp4`` naming so
+    ``keep_tmp_files`` behaves identically).
+    """
+    if not have_ffmpeg():
+        raise RuntimeError(
+            "ffmpeg is not installed; use the decoder's native fps resampling "
+            "(io.video.open_video(..., extraction_fps=..., use_ffmpeg='never')) instead"
+        )
+    if not video_path.endswith(".mp4"):
+        raise ValueError("The file does not end with .mp4")
+    os.makedirs(tmp_path, exist_ok=True)
+    new_path = os.path.join(tmp_path, f"{pathlib.Path(video_path).stem}_new_fps.mp4")
+    cmd = [
+        which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
+        "-i", video_path, "-filter:v", f"fps=fps={extraction_fps}", new_path,
+    ]
+    subprocess.call(cmd)
+    return new_path
+
+
+def extract_wav_from_mp4(video_path: str, tmp_path: str) -> Tuple[str, str]:
+    """mp4 → aac → wav via two ffmpeg calls (reference ``utils/utils.py:172-201``).
+
+    Returns (wav_path, aac_path); both land in ``tmp_path`` for ``keep_tmp_files``.
+    """
+    if not have_ffmpeg():
+        raise RuntimeError(
+            "ffmpeg is not installed; VGGish can only consume .wav inputs directly "
+            "on this host (pass paths ending in .wav)"
+        )
+    if not video_path.endswith(".mp4"):
+        raise ValueError("The file does not end with .mp4")
+    os.makedirs(tmp_path, exist_ok=True)
+    stem = pathlib.Path(video_path).stem
+    aac_path = os.path.join(tmp_path, f"{stem}.aac")
+    wav_path = os.path.join(tmp_path, f"{stem}.wav")
+    subprocess.call([
+        which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
+        "-i", video_path, "-acodec", "copy", aac_path,
+    ])
+    subprocess.call([
+        which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
+        "-i", aac_path, wav_path,
+    ])
+    return wav_path, aac_path

@@ -1,0 +1,72 @@
+"""Forming and sharding the list of input videos.
+
+Reproduces the semantics of ``form_list_from_user_input`` (``utils/utils.py:108-133``)
+and the round-robin job sharder ``gen_file_list.py:6-21`` of the reference. Sharding is
+also the multi-host data-parallel axis: each host takes ``shard(paths, host_id,
+num_hosts)`` and processes it independently (videos are embarrassingly parallel —
+SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+
+def form_video_list(
+    video_paths: Sequence[str] = (),
+    file_with_video_paths: Optional[str] = None,
+    warn_missing: bool = True,
+) -> List[str]:
+    """Return the list of video paths from either an explicit list or a .txt file.
+
+    A file wins over the explicit list (reference behavior, ``utils/utils.py:118-125``);
+    blank lines are dropped; missing paths are reported but kept (the per-video fault
+    barrier downstream will skip them).
+    """
+    if file_with_video_paths is not None:
+        with open(file_with_video_paths) as rfile:
+            path_list = [line.strip("\n") for line in rfile]
+        path_list = [p for p in path_list if p]
+    else:
+        path_list = list(video_paths)
+
+    if warn_missing:
+        for path in path_list:
+            if not os.path.exists(path):
+                print(f"The path does not exist: {path}")
+    return path_list
+
+
+def shard_round_robin(paths: Sequence[str], shard_id: int, num_shards: int) -> List[str]:
+    """Round-robin shard of the path list (reference ``gen_file_list.py:6-13``).
+
+    Used both for generating N job files and for multi-host DCN sharding: host k of N
+    processes ``shard_round_robin(paths, k, N)``.
+    """
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
+    return [p for i, p in enumerate(paths) if i % num_shards == shard_id]
+
+
+def write_shard_files(
+    video_dir: str, output_dir: str, num_shards: int, prefix: str = "file_list"
+) -> List[str]:
+    """Write N round-robin shard .txt files for launching N independent jobs.
+
+    Equivalent of the reference's ``gen_file_list.py`` helper script.
+    """
+    paths = sorted(
+        os.path.join(video_dir, name)
+        for name in os.listdir(video_dir)
+        if not name.startswith(".")
+    )
+    os.makedirs(output_dir, exist_ok=True)
+    out_files = []
+    for shard_id in range(num_shards):
+        shard = shard_round_robin(paths, shard_id, num_shards)
+        out_path = os.path.join(output_dir, f"{prefix}_{shard_id}.txt")
+        with open(out_path, "w") as f:
+            f.write("".join(p + "\n" for p in shard))
+        out_files.append(out_path)
+    return out_files

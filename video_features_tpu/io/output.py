@@ -1,0 +1,86 @@
+"""Output actions: what happens to a finished feature dict.
+
+Reproduces ``action_on_extraction`` (``utils/utils.py:45-74``) including the
+``<stem>_<key>.npy`` naming and the per-feature-type output subdirectory the reference
+extractors join before calling it (e.g. ``extract_i3d.py:78``). Adds a done-manifest so
+interrupted jobs can resume (the reference reruns everything — SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Dict, Mapping
+
+import numpy as np
+
+MANIFEST_NAME = ".done_manifest.jsonl"
+
+
+def feature_output_dir(output_path: str, feature_type: str) -> str:
+    """Features land in ``<output_path>/<feature_type>/`` (reference extract_*.py)."""
+    return os.path.join(output_path, feature_type)
+
+
+def action_on_extraction(
+    feats_dict: Mapping[str, np.ndarray],
+    video_path: str,
+    output_path: str,
+    on_extraction: str = "print",
+) -> Dict[str, str]:
+    """Print or save each array in ``feats_dict``.
+
+    ``print`` dumps the array plus a ``max/mean/min`` stats line (the reference's
+    numeric smoke test, ``utils/utils.py:57-61``); ``save_numpy`` writes
+    ``<stem>_<key>.npy`` under ``output_path``. Returns ``{key: saved_path}`` for
+    ``save_numpy`` (empty for ``print``).
+    """
+    saved: Dict[str, str] = {}
+    for key, value in feats_dict.items():
+        value = np.asarray(value)
+        if on_extraction == "print":
+            print(key)
+            print(value)
+            print(f"max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}")
+            print()
+        elif on_extraction == "save_numpy":
+            os.makedirs(output_path, exist_ok=True)
+            fname = f"{pathlib.Path(video_path).stem}_{key}.npy"
+            fpath = os.path.join(output_path, fname)
+            if value.ndim > 0 and len(value) == 0:
+                print(f"Warning: the value is empty for {key} @ {fpath}")
+            np.save(fpath, value)
+            saved[key] = fpath
+        else:
+            raise NotImplementedError(f"on_extraction: {on_extraction} is not implemented")
+    return saved
+
+
+def manifest_path(output_path: str) -> str:
+    return os.path.join(output_path, MANIFEST_NAME)
+
+
+def mark_done(output_path: str, video_path: str, keys) -> None:
+    """Append a completion record for ``video_path`` to the done-manifest."""
+    os.makedirs(output_path, exist_ok=True)
+    record = {"video": os.path.abspath(video_path), "keys": sorted(keys)}
+    with open(manifest_path(output_path), "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def load_done_set(output_path: str) -> set:
+    """Absolute video paths already completed according to the manifest."""
+    done = set()
+    path = manifest_path(output_path)
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    done.add(json.loads(line)["video"])
+                except (json.JSONDecodeError, KeyError):
+                    continue
+    return done

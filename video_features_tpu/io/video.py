@@ -1,0 +1,182 @@
+"""Host-side video decoding: cv2 → RGB uint8 frame stream with timestamps.
+
+Reproduces the reference decode-loop semantics (``extract_raft.py:110-151``,
+``extract_i3d.py:175-219``): BGR→RGB conversion, the first-frame-missing workaround for
+low-fps re-encodes, and per-frame ``CAP_PROP_POS_MSEC`` timestamps. fps changes use
+ffmpeg when available (exact reference parity, ``utils/utils.py:147-169``); otherwise a
+native timestamp-based frame sampler emulates ffmpeg's ``fps=`` filter without
+re-encoding (faster, no disk round-trip — preferred on TPU hosts).
+
+Decode is the canonical host-side hot loop (SURVEY.md §3.1); it feeds fixed-shape clip
+batches to the device pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional, Tuple
+
+import cv2
+import numpy as np
+
+from . import ffmpeg as ffmpeg_io
+
+
+@dataclass
+class VideoMeta:
+    path: str
+    fps: float
+    frame_count: int  # container header value; may be approximate
+    width: int
+    height: int
+
+
+def probe_video(video_path: str) -> VideoMeta:
+    cap = cv2.VideoCapture(video_path)
+    try:
+        return VideoMeta(
+            path=video_path,
+            fps=cap.get(cv2.CAP_PROP_FPS),
+            frame_count=int(cap.get(cv2.CAP_PROP_FRAME_COUNT)),
+            width=int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)),
+            height=int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)),
+        )
+    finally:
+        cap.release()
+
+
+def _raw_frames(cap: cv2.VideoCapture) -> Iterator[Tuple[np.ndarray, float]]:
+    """Yield (rgb_uint8_hwc, pos_msec) frames with the first-frame workaround.
+
+    The reference tolerates exactly one missing first frame (re-encoded low-fps videos
+    sometimes drop it — ``extract_raft.py:116-128``).
+    """
+    first_frame = True
+    while cap.isOpened():
+        frame_exists, bgr = cap.read()
+        if first_frame:
+            first_frame = False
+            if frame_exists is False:
+                continue
+        if not frame_exists:
+            cap.release()
+            break
+        rgb = cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB)
+        yield rgb, cap.get(cv2.CAP_PROP_POS_MSEC)
+
+
+def _resampled_frames(
+    cap: cv2.VideoCapture, src_fps: float, dst_fps: float
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """Emulate ffmpeg's ``fps=dst_fps`` filter by timestamp-nearest frame selection.
+
+    ffmpeg's fps filter emits one frame per output timestamp ``j / dst_fps``, choosing
+    the last input frame whose timestamp is <= the output timestamp (dropping or
+    duplicating as needed). We reproduce that selection on the decoded stream without
+    re-encoding.
+    """
+    out_idx = 0
+    prev: Optional[np.ndarray] = None
+    src_idx = -1
+    for rgb, _pos in _raw_frames(cap):
+        src_idx += 1
+        t_in = src_idx / src_fps
+        # emit all output frames whose timestamp falls strictly before this input frame
+        while (out_idx / dst_fps) < t_in - 1e-9:
+            frame = prev if prev is not None else rgb
+            out_idx += 1
+            yield frame.copy(), out_idx / dst_fps * 1000.0
+        prev = rgb
+    if prev is not None:
+        out_idx += 1
+        yield prev.copy(), out_idx / dst_fps * 1000.0
+
+
+def open_video(
+    video_path: str,
+    extraction_fps: Optional[int] = None,
+    tmp_path: str = "./tmp",
+    keep_tmp_files: bool = False,
+    use_ffmpeg: str = "auto",
+    transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+) -> Tuple[VideoMeta, Iterator[Tuple[np.ndarray, float]]]:
+    """Open a video; return (meta, iterator of (rgb_uint8_frame, pos_msec)).
+
+    ``extraction_fps`` changes the effective frame rate: via ffmpeg re-encode when
+    available (``use_ffmpeg='auto'``/'always'; exact reference parity) or via the
+    native sampler ('never' or no ffmpeg binary). ``transform``, if given, is applied
+    to each RGB frame on the host (e.g. PIL-bilinear resize).
+    """
+    if use_ffmpeg not in ("auto", "always", "never"):
+        raise ValueError(f"use_ffmpeg must be 'auto'|'always'|'never', got {use_ffmpeg!r}")
+    reencoded = None
+    if extraction_fps is not None and use_ffmpeg != "never":
+        if ffmpeg_io.have_ffmpeg():
+            reencoded = ffmpeg_io.reencode_video_with_diff_fps(
+                video_path, tmp_path, extraction_fps
+            )
+            video_path = reencoded
+        elif use_ffmpeg == "always":
+            raise RuntimeError(
+                "use_ffmpeg='always' requested for fps resampling but ffmpeg is not "
+                "installed; use use_ffmpeg='auto' to fall back to the native sampler"
+            )
+
+    cap = cv2.VideoCapture(video_path)
+    src_fps = cap.get(cv2.CAP_PROP_FPS)
+    src_count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
+    native_resample = extraction_fps is not None and reencoded is None
+    if native_resample:
+        if src_fps <= 0:
+            cap.release()
+            raise ValueError(
+                f"{video_path}: container reports fps={src_fps}; cannot resample to "
+                f"{extraction_fps} fps without a source rate"
+            )
+        # approximate post-resampling frame count (same duration, new rate)
+        out_count = int(round(src_count * float(extraction_fps) / src_fps)) if src_count > 0 else 0
+    else:
+        out_count = src_count
+    meta = VideoMeta(
+        path=video_path,
+        fps=float(extraction_fps) if extraction_fps is not None else src_fps,
+        frame_count=out_count,
+        width=int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)),
+        height=int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)),
+    )
+
+    if native_resample:
+        frames = _resampled_frames(cap, src_fps, float(extraction_fps))
+    else:
+        frames = _raw_frames(cap)
+
+    def _iter():
+        try:
+            for rgb, pos in frames:
+                if transform is not None:
+                    rgb = transform(rgb)
+                yield rgb, pos
+        finally:
+            cap.release()
+            if reencoded is not None and not keep_tmp_files and os.path.exists(reencoded):
+                os.remove(reencoded)
+
+    return meta, _iter()
+
+
+def decode_all(video_path: str, **kw) -> Tuple[VideoMeta, np.ndarray, np.ndarray]:
+    """Decode the whole video into (meta, frames (T,H,W,C) uint8, timestamps_ms (T,)).
+
+    Whole-video decode is the R(2+1)D path (reference uses
+    ``torchvision.io.read_video``, ``extract_r21d.py:102``); other models stream.
+    """
+    meta, frames = open_video(video_path, **kw)
+    out, ts = [], []
+    for rgb, pos in frames:
+        out.append(rgb)
+        ts.append(pos)
+    if not out:
+        h, w = max(meta.height, 0), max(meta.width, 0)
+        return meta, np.zeros((0, h, w, 3), np.uint8), np.zeros((0,))
+    return meta, np.stack(out), np.asarray(ts)

@@ -1,0 +1,1 @@
+"""Numerical ops: host image preprocessing and device-side (XLA/Pallas) kernels."""

@@ -1,0 +1,96 @@
+"""Image preprocessing: host-side PIL-semantics resize, device-side crop/normalize.
+
+The reference resizes frames with PIL bilinear (``models/i3d/transforms/
+transforms.py:87-137`` ``resize``/``ResizeImproved``) and crops/normalizes in torch.
+PIL's resampling differs from XLA's ``jax.image.resize`` in rounding and filter
+support, so for bit-parity the aspect-preserving edge resize stays on the host (PIL on
+uint8 is exactly what the reference computes); everything after — center crop, scaling
+to [-1,1], flow quantization — is pure elementwise math and runs on device inside the
+jitted forward (:mod:`video_features_tpu.extractors`), where XLA fuses it into the
+first conv.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+from PIL import Image
+
+import jax.numpy as jnp
+
+
+def edge_resize_size(
+    width: int, height: int, size: int, to_smaller_edge: bool = True
+) -> Tuple[int, int]:
+    """Output (width, height) of the aspect-preserving edge resize.
+
+    Matches the reference's int-truncation arithmetic (``transforms.py:114-125``): the
+    chosen edge becomes ``size``, the other ``int(size * other / chosen)``; no-op when
+    the chosen edge already equals ``size`` and the image is no larger on the other
+    axis than required by PIL semantics.
+    """
+    w, h = width, height
+    if (w <= h and w == size) or (h <= w and h == size):
+        return w, h
+    if (w < h) == to_smaller_edge:
+        return size, int(size * h / w)
+    return int(size * w / h), size
+
+
+def pil_edge_resize(
+    rgb_hwc: np.ndarray, size: Optional[int], to_smaller_edge: bool = True
+) -> np.ndarray:
+    """Resize an RGB uint8 HWC frame so its smaller (or larger) edge equals ``size``.
+
+    PIL bilinear on uint8 — identical bytes to the reference's host path. ``size=None``
+    is the identity (RAFT/PWC run at native resolution unless ``--side_size``).
+    """
+    if size is None:
+        return rgb_hwc
+    h, w = rgb_hwc.shape[:2]
+    ow, oh = edge_resize_size(w, h, size, to_smaller_edge)
+    if (ow, oh) == (w, h):
+        return rgb_hwc
+    return np.asarray(Image.fromarray(rgb_hwc).resize((ow, oh), Image.BILINEAR))
+
+
+def center_crop(x: jnp.ndarray, crop_size: int) -> jnp.ndarray:
+    """Center crop over the trailing two spatial dims (``transforms.py:7-18``)."""
+    h, w = x.shape[-2], x.shape[-1]
+    fh = (h - crop_size) // 2
+    fw = (w - crop_size) // 2
+    return x[..., fh : fh + crop_size, fw : fw + crop_size]
+
+
+def center_crop_hw(x: jnp.ndarray, th: int, tw: int) -> jnp.ndarray:
+    """Center crop to (th, tw) with round-half-up offsets (R21D semantics,
+    ``r21d/transforms/rgb_transforms.py`` ``center_crop``: ``int(round((h-th)/2))``)."""
+    h, w = x.shape[-2], x.shape[-1]
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return x[..., i : i + th, j : j + tw]
+
+
+def scale_to_pm1(x: jnp.ndarray) -> jnp.ndarray:
+    """[0,255] → [-1,1]: ``2x/255 - 1`` (``transforms.py:21-24``)."""
+    return 2.0 * x / 255.0 - 1.0
+
+
+def flow_to_uint8_levels(flow: jnp.ndarray) -> jnp.ndarray:
+    """Clamp flow to ±20 and quantize to uint8 levels (kept float).
+
+    ``round(128 + 255/40 * clamp(f, -20, 20))`` — the kinetics-i3d flow preprocessing
+    the reference applies before its flow I3D stream (``transforms.py:43-51`` with
+    ``Clamp(-20,20)`` from ``extract_i3d.py:65-71``). jnp.round matches torch's
+    round-half-to-even.
+    """
+    clamped = jnp.clip(flow, -20.0, 20.0)
+    return jnp.round(128.0 + 255.0 / 40.0 * clamped)
+
+
+def imagenet_normalize(x: jnp.ndarray, mean, std) -> jnp.ndarray:
+    """Channel-wise (x/255 - mean)/std for CHW or NCHW float input in [0,255]."""
+    mean = jnp.asarray(mean, x.dtype).reshape(-1, 1, 1)
+    std = jnp.asarray(std, x.dtype).reshape(-1, 1, 1)
+    return (x / 255.0 - mean) / std

@@ -1,0 +1,95 @@
+"""Pure window/slice index math for clip and frame-pair pipelines.
+
+The reference interleaves this arithmetic with its decode loops
+(``utils/utils.py:76-85`` ``form_slices``; the I3D B+1-frame sliding window
+``extract_i3d.py:188-219``; RAFT's carry-last-frame batching
+``extract_raft.py:122-151``). Here it is pure index planning: given a frame count,
+produce static index arrays up front. Static plans are what let the device side run
+fixed-shape, jit-once batches instead of data-dependent Python loops.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+
+def form_slices(size: int, stack_size: int, step_size: int) -> List[Tuple[int, int]]:
+    """(start, end) index pairs of every *full* stack (reference ``utils/utils.py:76-85``).
+
+    Trailing frames that don't fill a stack are dropped, matching the reference.
+    """
+    if stack_size <= 0 or step_size <= 0:
+        raise ValueError("stack_size and step_size must be positive")
+    slices = []
+    full_stack_num = (size - stack_size) // step_size + 1
+    for i in range(max(full_stack_num, 0)):
+        start = i * step_size
+        slices.append((start, start + stack_size))
+    return slices
+
+
+def slice_starts(size: int, stack_size: int, step_size: int) -> np.ndarray:
+    """Start indices of every full stack as an int32 array (device-friendly plan)."""
+    return np.asarray([s for s, _ in form_slices(size, stack_size, step_size)], np.int32)
+
+
+def flow_stack_plan(num_frames: int, stack_size: int, step_size: int) -> np.ndarray:
+    """Frame-window starts for flow-fed clip models (I3D).
+
+    Each window covers ``stack_size + 1`` frames: B consecutive frame pairs give B flow
+    maps, and the rgb stream uses the first B frames of the window so both streams stay
+    temporally aligned (reference ``extract_i3d.py:144-156,207-213``: reads 65 frames,
+    drops the last rgb frame, keeps ``stack[step_size:]`` as overlap).
+
+    Returns start indices of shape (num_stacks,); window w covers frames
+    ``[start, start + stack_size]`` inclusive.
+    """
+    return slice_starts(max(num_frames - 1, 0), stack_size, step_size)
+
+
+def pair_batch_plan(num_frames: int, batch_size: int) -> List[Tuple[int, int]]:
+    """(start, end) frame ranges for frame-pair (optical flow) batches.
+
+    Reproduces RAFT/PWC batching semantics (``extract_raft.py:122-151``): the decoder
+    accumulates ``batch_size + 1`` frames, computes flow between ``batch[:-1]`` and
+    ``batch[1:]``, then carries the last frame into the next batch; a final partial
+    batch runs if it holds at least one pair. Range (start, end) is inclusive of end;
+    it yields ``end - start`` flow maps for pairs (start, start+1) ... (end-1, end).
+    """
+    if batch_size < 1:
+        raise ValueError("batch_size must be >= 1")
+    ranges = []
+    start = 0
+    while start + 1 <= num_frames - 1:
+        end = min(start + batch_size, num_frames - 1)
+        ranges.append((start, end))
+        start = end
+    return ranges
+
+
+def frame_batch_plan(num_frames: int, batch_size: int) -> List[Tuple[int, int]]:
+    """(start, end) half-open ranges for frame-wise models (ResNet-50).
+
+    The reference flushes every ``batch_size`` frames and once more for the partial
+    tail (``extract_resnet50.py:118-143``).
+    """
+    if batch_size < 1:
+        raise ValueError("batch_size must be >= 1")
+    return [(s, min(s + batch_size, num_frames)) for s in range(0, num_frames, batch_size)]
+
+
+def timestamps_ms(starts: np.ndarray, stack_size: int, fps: float) -> np.ndarray:
+    """Timestamp (ms) of the last decoded frame of each window.
+
+    The reference logs ``cap.get(CAP_PROP_POS_MSEC)`` when a stack completes
+    (``extract_i3d.py:215``); the last frame decoded for window ``start`` is index
+    ``start + stack_size`` (the +1-th frame of the flow pair window). Under cv2 >= 4,
+    ``POS_MSEC`` after reading frame k is ``k / fps * 1000`` (frame 0 → 0.0), so the
+    completed-stack timestamp is ``(start + stack_size) / fps * 1000``. Prefer the
+    decoder's actual per-frame positions when available (variable-fps containers);
+    this helper is the constant-fps plan used for pre-decoded arrays.
+    """
+    starts = np.asarray(starts, np.float64)
+    return (starts + stack_size) / float(fps) * 1000.0
