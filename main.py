@@ -1,0 +1,38 @@
+"""Extract features from videos — TPU-native CLI.
+
+Drop-in surface of the reference ``main.py`` (same flags), e.g.::
+
+    python main.py --feature_type i3d --video_paths a.mp4 b.mp4 --on_extraction save_numpy
+
+Videos are embarrassingly parallel: the list is processed by the extractor, whose
+device step is jit-compiled for the local TPU mesh; multi-host jobs shard the list
+round-robin per host (``--num_devices`` governs the local mesh size).
+"""
+
+import sys
+
+from video_features_tpu.cli import parse_args
+from video_features_tpu.extractors import get_extractor
+
+
+def main(argv=None) -> int:
+    cfg = parse_args(argv)
+    extractor = get_extractor(cfg)
+    paths = extractor.video_list()
+    if not paths:
+        print("No videos to process.")
+        return 1
+
+    def progress(done, total):
+        print(f"\r[{done}/{total}] videos processed", end="", flush=True)
+
+    ok = extractor.run(paths, progress=progress)
+    print()
+    failed = len(paths) - ok
+    if failed:
+        print(f"{failed} video(s) failed (see log above)")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

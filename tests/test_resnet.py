@@ -1,0 +1,71 @@
+"""Flax ResNet-50 numerical parity vs a torch mirror (random weights)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from torch_mirrors import ResNet50 as TorchResNet50, random_init_
+from video_features_tpu.models.resnet import ResNet50, preprocess_frames
+from video_features_tpu.weights.convert_torch import convert_resnet50
+
+
+@pytest.fixture(scope="module")
+def converted():
+    tm = random_init_(TorchResNet50(), seed=3)
+    params = convert_resnet50(tm.state_dict())
+    return tm, params
+
+
+def test_param_tree_matches_model(converted):
+    tm, params = converted
+    model = ResNet50()
+    # features=False so the fc head is created too
+    init = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), features=False)["params"]
+    init_paths = {tuple(p) for p, _ in jax.tree_util.tree_flatten_with_path(init)[0]}
+    conv_paths = {tuple(p) for p, _ in jax.tree_util.tree_flatten_with_path(
+        jax.tree_util.tree_map(jnp.asarray, params))[0]}
+    assert {str(p) for p in init_paths} == {str(p) for p in conv_paths}
+    # shapes agree everywhere
+    jax.tree_util.tree_map(lambda a, b: None if a.shape == b.shape else (_ for _ in ()).throw(
+        AssertionError(f"{a.shape} vs {b.shape}")), init, jax.tree_util.tree_map(jnp.asarray, params))
+
+
+def test_features_parity(converted):
+    tm, params = converted
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 32, 32, 3), dtype=np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x).permute(0, 3, 1, 2), features=True).numpy()
+    out = ResNet50().apply({"params": params}, jnp.asarray(x), features=True)
+    out = np.asarray(out)
+    assert out.shape == ref.shape == (2, 2048)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_logits_parity(converted):
+    tm, params = converted
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((1, 32, 32, 3), dtype=np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x).permute(0, 3, 1, 2), features=False).numpy()
+    out = np.asarray(ResNet50().apply({"params": params}, jnp.asarray(x), features=False))
+    assert out.shape == (1, 1000)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_preprocess_matches_torch_normalize():
+    rng = np.random.default_rng(2)
+    u8 = rng.integers(0, 256, (3, 8, 8, 3), dtype=np.uint8)
+    mean = torch.tensor([0.485, 0.456, 0.406]).view(3, 1, 1)
+    std = torch.tensor([0.229, 0.224, 0.225]).view(3, 1, 1)
+    ref = ((torch.from_numpy(u8).permute(0, 3, 1, 2).float() / 255.0) - mean) / std
+    out = np.asarray(preprocess_frames(jnp.asarray(u8)))
+    np.testing.assert_allclose(out, ref.permute(0, 2, 3, 1).numpy(), rtol=1e-6, atol=1e-6)

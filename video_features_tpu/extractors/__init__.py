@@ -1,0 +1,34 @@
+"""Per-model extraction pipelines sharing one skeleton.
+
+The reference duplicates the decode→transform→batch→forward→collect→output skeleton
+in every ``extract_<name>.py`` (SURVEY.md §1); here it is factored once into
+:class:`base.Extractor` with per-model subclasses that supply the host transform,
+the window plan, and the jitted device step.
+"""
+
+from typing import TYPE_CHECKING
+
+
+def get_extractor(cfg):
+    """Instantiate the extractor for ``cfg.feature_type`` (lazy imports keep
+    startup light, mirroring the reference's in-branch imports ``main.py:15-33``)."""
+    ft = cfg.feature_type
+    if ft == "resnet50":
+        from .resnet import ExtractResNet50
+        return ExtractResNet50(cfg)
+    if ft == "r21d_rgb":
+        from .r21d import ExtractR21D
+        return ExtractR21D(cfg)
+    if ft == "i3d":
+        from .i3d import ExtractI3D
+        return ExtractI3D(cfg)
+    if ft == "raft":
+        from .raft import ExtractRAFT
+        return ExtractRAFT(cfg)
+    if ft == "pwc":
+        from .pwc import ExtractPWC
+        return ExtractPWC(cfg)
+    if ft == "vggish":
+        from .vggish import ExtractVGGish
+        return ExtractVGGish(cfg)
+    raise ValueError(f"unknown feature_type: {ft}")

@@ -1,0 +1,89 @@
+"""Shared extraction pipeline skeleton.
+
+Factors the loop every reference extractor re-implements (``extract_*.py``): iterate
+videos with a per-video fault barrier (log & continue — ``extract_i3d.py:107-117``),
+hand each finished feature dict to the output action, track progress. Adds what the
+reference lacks: a done-manifest for resume and device-count awareness.
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import ExtractionConfig, resolve_model_defaults
+from ..io.filelist import form_video_list
+from ..io.output import (
+    action_on_extraction,
+    feature_output_dir,
+    load_done_set,
+    mark_done,
+)
+
+
+class Extractor(abc.ABC):
+    """Base class for all per-model pipelines."""
+
+    def __init__(self, cfg: ExtractionConfig):
+        cfg = resolve_model_defaults(cfg)
+        cfg.validate()
+        self.cfg = cfg
+        self.feature_type = cfg.feature_type
+        # per-feature-type subdirs, as the reference joins them (extract_i3d.py:77-78)
+        self.output_dir = feature_output_dir(cfg.output_path, cfg.feature_type)
+        self.tmp_dir = os.path.join(cfg.tmp_path, cfg.feature_type)
+
+    # --- per-model API ---
+
+    @abc.abstractmethod
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        """Extract features for one video; keys become output-file suffixes."""
+
+    # --- shared driver ---
+
+    def video_list(self) -> List[str]:
+        return form_video_list(self.cfg.video_paths, self.cfg.file_with_video_paths)
+
+    def run(self, video_paths: Optional[Sequence[str]] = None, progress=None) -> int:
+        """Process all videos with the per-video fault barrier; returns #succeeded.
+
+        ``progress``: optional callable invoked after each video (done, total).
+        """
+        paths = list(video_paths) if video_paths is not None else self.video_list()
+        done = load_done_set(self.output_dir) if self.cfg.resume else set()
+        ok = 0
+        for n, path in enumerate(paths, start=1):
+            if os.path.abspath(path) in done:
+                ok += 1
+                if progress:
+                    progress(n, len(paths))
+                continue
+            try:
+                feats_dict = self.extract(path)
+                action_on_extraction(feats_dict, path, self.output_dir, self.cfg.on_extraction)
+                if self.cfg.on_extraction == "save_numpy":
+                    mark_done(self.output_dir, path, feats_dict.keys())
+                ok += 1
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — per-video fault barrier
+                print(e)
+                print(f"Extraction failed at: {path} with error (↑). Continuing extraction")
+            if progress:
+                progress(n, len(paths))
+        return ok
+
+
+def pad_batch(arr: np.ndarray, batch_size: int) -> np.ndarray:
+    """Zero-pad the leading axis to ``batch_size`` (static shapes: one XLA compile
+    per geometry instead of one per partial tail batch)."""
+    n = arr.shape[0]
+    if n == batch_size:
+        return arr
+    if n > batch_size:
+        raise ValueError(f"batch of {n} exceeds batch_size {batch_size}")
+    pad = np.zeros((batch_size - n,) + arr.shape[1:], arr.dtype)
+    return np.concatenate([arr, pad], axis=0)

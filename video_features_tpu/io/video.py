@@ -110,6 +110,8 @@ def open_video(
     """
     if use_ffmpeg not in ("auto", "always", "never"):
         raise ValueError(f"use_ffmpeg must be 'auto'|'always'|'never', got {use_ffmpeg!r}")
+    if not os.path.exists(video_path):
+        raise FileNotFoundError(f"video does not exist: {video_path}")
     reencoded = None
     if extraction_fps is not None and use_ffmpeg != "never":
         if ffmpeg_io.have_ffmpeg():

@@ -1,0 +1,6 @@
+"""Flax model definitions: I3D, R(2+1)D, ResNet-50, RAFT, PWC-Net, VGGish.
+
+All models are inference-first: BatchNorm runs off converted running statistics,
+layouts are NHWC/NDHWC (TPU-native), and every forward is shape-static so XLA
+compiles it once per input geometry.
+"""

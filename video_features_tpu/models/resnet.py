@@ -1,0 +1,137 @@
+"""ResNet-50 in Flax (NHWC), matching torchvision's ``resnet50`` numerics.
+
+The reference consumes torchvision's pretrained ResNet-50 with the classifier head
+swapped for identity and kept aside for ``--show_pred``
+(``/root/reference/models/resnet50/extract_resnet50.py:54-58``). This module defines
+the same architecture TPU-natively: NHWC layout so convs tile straight onto the MXU,
+inference-mode BatchNorm (running statistics are parameters), and a ``features``
+switch mirroring the identity-head behavior — ``features=True`` returns the 2048-d
+global-average-pooled embedding, ``features=False`` additionally applies the fc head
+and returns logits.
+
+Param tree follows torchvision naming (``conv1``, ``bn1``, ``layer1.0.conv2``, ...)
+so checkpoint conversion (:mod:`video_features_tpu.weights.convert_torch`) is a pure
+name-and-layout map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+BN_EPS = 1e-5  # torch.nn.BatchNorm2d default
+
+
+class TorchBatchNorm(nn.Module):
+    """Inference BatchNorm with torch semantics: y = (x-mean)/sqrt(var+eps)*scale+bias.
+
+    Running statistics live in the ``params`` collection (they are converted weights,
+    never updated), which keeps the whole model a single frozen pytree.
+    """
+
+    eps: float = BN_EPS
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (c,), jnp.float32)
+        # compute the affine in fp32 then cast: matches torch eval-mode numerics
+        inv = jnp.asarray(scale, jnp.float32) / jnp.sqrt(jnp.asarray(var, jnp.float32) + self.eps)
+        y = (x.astype(jnp.float32) - mean) * inv + bias
+        return y.astype(self.dtype)
+
+
+def max_pool_torch(x: jnp.ndarray, window: int, stride: int, padding: int) -> jnp.ndarray:
+    """torch ``MaxPool2d(window, stride, padding)`` on NHWC (pads with -inf)."""
+    return nn.max_pool(
+        x,
+        (window, window),
+        strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+    )
+
+
+class Bottleneck(nn.Module):
+    """torchvision Bottleneck (v1.5: stride on the 3x3 conv)."""
+
+    planes: int
+    stride: int = 1
+    downsample: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        identity = x
+        out = nn.Conv(self.planes, (1, 1), use_bias=False, dtype=self.dtype, name="conv1")(x)
+        out = TorchBatchNorm(dtype=self.dtype, name="bn1")(out)
+        out = nn.relu(out)
+        out = nn.Conv(
+            self.planes, (3, 3), strides=(self.stride, self.stride),
+            padding=[(1, 1), (1, 1)], use_bias=False, dtype=self.dtype, name="conv2",
+        )(out)
+        out = TorchBatchNorm(dtype=self.dtype, name="bn2")(out)
+        out = nn.relu(out)
+        out = nn.Conv(self.planes * 4, (1, 1), use_bias=False, dtype=self.dtype, name="conv3")(out)
+        out = TorchBatchNorm(dtype=self.dtype, name="bn3")(out)
+        if self.downsample:
+            identity = nn.Conv(
+                self.planes * 4, (1, 1), strides=(self.stride, self.stride),
+                use_bias=False, dtype=self.dtype, name="downsample.0",
+            )(x)
+            identity = TorchBatchNorm(dtype=self.dtype, name="downsample.1")(identity)
+        return nn.relu(out + identity)
+
+
+class ResNet50(nn.Module):
+    """torchvision ``resnet50`` architecture; input NHWC float, ImageNet-normalized."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, features: bool = True) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype, name="conv1")(x)
+        x = TorchBatchNorm(dtype=self.dtype, name="bn1")(x)
+        x = nn.relu(x)
+        x = max_pool_torch(x, 3, 2, 1)
+
+        planes = 64
+        for stage, blocks in enumerate(self.stage_sizes, start=1):
+            for b in range(blocks):
+                stride = 2 if (stage > 1 and b == 0) else 1
+                x = Bottleneck(
+                    planes=planes, stride=stride, downsample=(b == 0),
+                    dtype=self.dtype, name=f"layer{stage}.{b}",
+                )(x)
+            planes *= 2
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool → (N, 2048)
+        if features:
+            return x
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="fc")(x)
+
+
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+
+
+def preprocess_frames(frames_u8_nhwc: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """uint8 NHWC (already resized+cropped on host) → normalized float NHWC.
+
+    Reference transform stack: ``ToTensor`` (/255) + ImageNet ``Normalize``
+    (``extract_resnet50.py:32-38``). Runs on device inside the jitted forward so XLA
+    fuses it into the first conv.
+    """
+    x = frames_u8_nhwc.astype(jnp.float32) / 255.0
+    mean = jnp.asarray(IMAGENET_MEAN, jnp.float32)
+    std = jnp.asarray(IMAGENET_STD, jnp.float32)
+    return ((x - mean) / std).astype(dtype)

@@ -89,6 +89,15 @@ def flow_to_uint8_levels(flow: jnp.ndarray) -> jnp.ndarray:
     return jnp.round(128.0 + 255.0 / 40.0 * clamped)
 
 
+def np_center_crop_hwc(frame: np.ndarray, th: int, tw: int) -> np.ndarray:
+    """Host-side center crop of an HWC frame with torchvision's round-half offsets
+    (``torchvision.transforms.CenterCrop``: ``crop_top = int(round((h - th) / 2))``)."""
+    h, w = frame.shape[:2]
+    i = int(round((h - th) / 2.0))
+    j = int(round((w - tw) / 2.0))
+    return frame[i : i + th, j : j + tw]
+
+
 def imagenet_normalize(x: jnp.ndarray, mean, std) -> jnp.ndarray:
     """Channel-wise (x/255 - mean)/std for CHW or NCHW float input in [0,255]."""
     mean = jnp.asarray(mean, x.dtype).reshape(-1, 1, 1)

@@ -1,0 +1,43 @@
+"""Class-label maps and top-k prediction printing (``--show_pred``).
+
+Reproduces ``show_predictions_on_dataset`` (``utils/utils.py:15-42``): top-5 classes
+with logit and softmax scores, one block per batch row. Label lists are bundled as
+JSON data (Kinetics-400 / ImageNet-1k class names — public dataset metadata).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+from typing import List
+
+import numpy as np
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data")
+_FILES = {
+    "kinetics": "kinetics400_labels.json",
+    "imagenet": "imagenet1k_labels.json",
+}
+
+
+@lru_cache(maxsize=None)
+def class_names(dataset: str) -> List[str]:
+    if dataset not in _FILES:
+        raise NotImplementedError(f"no label map for dataset {dataset!r}")
+    with open(os.path.join(_DATA_DIR, _FILES[dataset])) as f:
+        return json.load(f)
+
+
+def show_predictions_on_dataset(logits: np.ndarray, dataset: str, k: int = 5) -> None:
+    """Print top-k ``<logit> <softmax> <class>`` lines per row (reference format)."""
+    logits = np.asarray(logits, np.float64)
+    names = class_names(dataset)
+    # row-wise softmax
+    z = logits - logits.max(axis=-1, keepdims=True)
+    softmax = np.exp(z) / np.exp(z).sum(axis=-1, keepdims=True)
+    top_idx = np.argsort(-softmax, axis=-1)[:, :k]
+    for row, idx in enumerate(top_idx):
+        for i in idx:
+            print(f"{logits[row, i]:.3f} {softmax[row, i]:.3f} {names[i]}")
+        print()

@@ -1,0 +1,9 @@
+"""Checkpoint conversion: torch / TF checkpoints → Flax param pytrees.
+
+The reference loads torch ``state_dict``s from hard-coded paths
+(``extract_i3d.py:98,105``, ``extract_raft.py:60``, ``extract_pwc.py:58``),
+torchvision ``pretrained=True`` downloads, and a TF-slim Saver checkpoint for VGGish
+(``vggish_slim.py:102-129``). Here every model has a pure name-and-layout converter so
+any of those checkpoint files can be turned into the Flax param tree once and stored
+via orbax/msgpack.
+"""

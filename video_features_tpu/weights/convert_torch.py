@@ -1,0 +1,83 @@
+"""torch state_dict → Flax param tree converters (pure numpy; torch not required).
+
+Layout rules:
+- conv2d ``(O, I, H, W)`` → ``(H, W, I, O)`` (flax NHWC kernels)
+- conv3d ``(O, I, D, H, W)`` → ``(D, H, W, I, O)`` (flax NDHWC kernels)
+- linear ``(O, I)`` → ``(I, O)``
+- BatchNorm ``weight/bias/running_mean/running_var`` → ``scale/bias/mean/var``
+
+Name rules are per-model; each converter returns the nested dict matching the
+corresponding Flax module's ``params`` collection.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Tuple
+
+import numpy as np
+
+
+def to_numpy_state_dict(state_dict: Mapping) -> Dict[str, np.ndarray]:
+    """Detach a torch state_dict to plain numpy (accepts numpy passthrough)."""
+    out = {}
+    for k, v in state_dict.items():
+        if hasattr(v, "detach"):
+            v = v.detach().cpu().numpy()
+        out[k] = np.asarray(v)
+    return out
+
+
+def conv2d_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def conv3d_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 4, 1, 0))
+
+
+def linear_kernel(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w)
+
+
+def set_path(tree: dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+_BN_MAP = {"weight": "scale", "bias": "bias", "running_mean": "mean", "running_var": "var"}
+
+
+def convert_bn(sd: Mapping[str, np.ndarray], torch_prefix: str, tree: dict,
+               flax_path: Tuple[str, ...]) -> None:
+    for tname, fname in _BN_MAP.items():
+        set_path(tree, flax_path + (fname,), np.asarray(sd[f"{torch_prefix}.{tname}"]))
+
+
+def convert_resnet50(state_dict: Mapping) -> dict:
+    """torchvision ``resnet50`` state_dict → :class:`models.resnet.ResNet50` params."""
+    sd = to_numpy_state_dict(state_dict)
+    params: dict = {}
+
+    set_path(params, ("conv1", "kernel"), conv2d_kernel(sd["conv1.weight"]))
+    convert_bn(sd, "bn1", params, ("bn1",))
+
+    stage_sizes = (3, 4, 6, 3)
+    for stage, blocks in enumerate(stage_sizes, start=1):
+        for b in range(blocks):
+            t = f"layer{stage}.{b}"
+            f = f"layer{stage}.{b}"
+            for conv in ("conv1", "conv2", "conv3"):
+                set_path(params, (f, conv, "kernel"), conv2d_kernel(sd[f"{t}.{conv}.weight"]))
+            for bn in ("bn1", "bn2", "bn3"):
+                convert_bn(sd, f"{t}.{bn}", params, (f, bn))
+            if f"{t}.downsample.0.weight" in sd:
+                set_path(params, (f, "downsample.0", "kernel"),
+                         conv2d_kernel(sd[f"{t}.downsample.0.weight"]))
+                convert_bn(sd, f"{t}.downsample.1", params, (f, "downsample.1"))
+
+    if "fc.weight" in sd:
+        set_path(params, ("fc", "kernel"), linear_kernel(sd["fc.weight"]))
+        set_path(params, ("fc", "bias"), np.asarray(sd["fc.bias"]))
+    return params

@@ -1,0 +1,101 @@
+"""Checkpoint resolution: find, convert, and cache model weights.
+
+The reference hard-codes checkpoint paths and downloads torchvision weights on first
+use (SURVEY.md §2.1 #25). This image has no network egress, so the store resolves
+weights from local files and falls back to deterministic random initialization when
+explicitly allowed (smoke tests, benchmarks — feature *values* then differ from the
+pretrained reference but shapes, dtypes, and compute are identical).
+
+Resolution order for model key ``<name>``:
+1. explicit ``checkpoint_path`` argument
+2. ``$VFT_CHECKPOINT_DIR/<name>.npz`` (converted Flax params, flat ``a/b/c`` keys)
+3. ``./checkpoints/<name>.npz``
+4. a torch file at either location (``<name>.pt``/``.pth``) run through the model's
+   converter (requires torch)
+5. random init iff ``$VFT_ALLOW_RANDOM_WEIGHTS=1`` or ``allow_random=True``
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+ENV_DIR = "VFT_CHECKPOINT_DIR"
+ENV_ALLOW_RANDOM = "VFT_ALLOW_RANDOM_WEIGHTS"
+
+
+def flatten_params(tree: dict, prefix: str = "") -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten_params(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    return tree
+
+
+def save_params_npz(path: str, params: dict) -> None:
+    np.savez(path, **flatten_params(params))
+
+
+def load_params_npz(path: str) -> dict:
+    with np.load(path) as z:
+        return unflatten_params({k: z[k] for k in z.files})
+
+
+def _candidates(name: str):
+    dirs = []
+    if os.environ.get(ENV_DIR):
+        dirs.append(os.environ[ENV_DIR])
+    dirs.append("./checkpoints")
+    for d in dirs:
+        for ext in (".npz", ".pt", ".pth"):
+            yield os.path.join(d, name + ext)
+
+
+def resolve_params(
+    name: str,
+    convert_torch_fn: Optional[Callable[[dict], dict]] = None,
+    init_fn: Optional[Callable[[], dict]] = None,
+    checkpoint_path: Optional[str] = None,
+    allow_random: bool = False,
+) -> dict:
+    """Return the Flax param tree for model ``name`` per the resolution order above."""
+    paths = [checkpoint_path] if checkpoint_path else list(_candidates(name))
+    for path in paths:
+        if path is None or not os.path.exists(path):
+            continue
+        if path.endswith(".npz"):
+            return load_params_npz(path)
+        if convert_torch_fn is None:
+            raise ValueError(f"{path}: torch checkpoint given but no converter for {name}")
+        import torch  # local import: torch is host-side tooling only
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        if isinstance(sd, dict) and "state_dict" in sd:
+            sd = sd["state_dict"]
+        return convert_torch_fn(sd)
+
+    if allow_random or os.environ.get(ENV_ALLOW_RANDOM) == "1":
+        if init_fn is None:
+            raise ValueError(f"no init_fn provided for random weights of {name}")
+        return init_fn()
+    raise FileNotFoundError(
+        f"no checkpoint found for {name!r} (searched {paths}); place converted "
+        f"weights at $VFT_CHECKPOINT_DIR/{name}.npz or a torch checkpoint at "
+        f"./checkpoints/{name}.pt, or set {ENV_ALLOW_RANDOM}=1 for random weights"
+    )
