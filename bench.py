@@ -1,0 +1,68 @@
+"""Throughput benchmark: frames/sec through the jitted ResNet-50 feature step.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` compares against
+a locally recorded reference-equivalent torch-CPU measurement when available
+(``BASELINE.json`` key ``measured.resnet50_fps``), else 0.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from video_features_tpu.models.resnet import ResNet50, preprocess_frames
+
+    batch, size = 64, 224
+    model = ResNet50()
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3)), features=False
+    )["params"]
+
+    @jax.jit
+    def step(params, frames_u8):
+        x = preprocess_frames(frames_u8)
+        return model.apply({"params": params}, x, features=True).astype(jnp.float32)
+
+    frames = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (batch, size, size, 3), dtype=np.uint8)
+    )
+    step(params, frames).block_until_ready()  # compile
+
+    n_iters = 10
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        out = step(params, frames)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    fps = batch * n_iters / dt
+
+    baseline = 0.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = float(json.load(f).get("measured", {}).get("resnet50_fps", 0.0))
+    except Exception:
+        pass
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_features_throughput",
+                "value": round(fps, 2),
+                "unit": "frames/sec",
+                "vs_baseline": round(fps / baseline, 3) if baseline else 0.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

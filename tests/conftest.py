@@ -18,6 +18,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The image's sitecustomize pre-registers the axon TPU backend and pins
+# jax_platforms before conftest runs, so the env var alone is not enough —
+# force the config through the API as well.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 

@@ -8,10 +8,9 @@ from video_features_tpu.extractors.resnet import ExtractResNet50
 
 
 @pytest.fixture(scope="module")
-def extractor(tmp_path_factory, monkeypatch_session=None):
-    import os
-
-    os.environ["VFT_ALLOW_RANDOM_WEIGHTS"] = "1"
+def extractor(tmp_path_factory):
+    mp = pytest.MonkeyPatch()
+    mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
     out = tmp_path_factory.mktemp("out")
     cfg = ExtractionConfig(
         feature_type="resnet50",
@@ -19,7 +18,8 @@ def extractor(tmp_path_factory, monkeypatch_session=None):
         output_path=str(out),
         batch_size=64,
     )
-    return ExtractResNet50(cfg)
+    yield ExtractResNet50(cfg)
+    mp.undo()
 
 
 def test_extract_sample(extractor, sample_video):
@@ -28,8 +28,18 @@ def test_extract_sample(extractor, sample_video):
     assert feats["timestamps_ms"].shape == (355,)
     assert float(feats["fps"]) == pytest.approx(19.62, abs=0.01)
     assert np.isfinite(feats["resnet50"]).all()
-    # padding must not leak: re-running a prefix with a different tail gives same rows
-    # (batch 64 → last batch has 355 % 64 = 35 valid rows)
+
+
+def test_tail_padding_does_not_leak(extractor):
+    """Rows of a padded tail batch must equal the same frames run as a full batch."""
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (64, 224, 224, 3), dtype=np.uint8)
+    full = np.asarray(extractor._step(extractor.params, frames))
+    from video_features_tpu.extractors.base import pad_batch
+
+    tail = pad_batch(frames[:5], 64)
+    padded = np.asarray(extractor._step(extractor.params, tail))[:5]
+    np.testing.assert_allclose(padded, full[:5], rtol=1e-5, atol=1e-5)
 
 
 def test_run_fault_barrier(extractor, sample_video, capsys):

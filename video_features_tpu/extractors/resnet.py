@@ -44,6 +44,11 @@ class ExtractResNet50(Extractor):
             convert_torch_fn=convert_resnet50,
             init_fn=self._random_init,
         )
+        if cfg.show_pred and "fc" not in self.params:
+            raise ValueError(
+                "--show_pred needs the classifier head, but the resolved resnet50 "
+                "checkpoint has no 'fc' params (feature-only checkpoint)"
+            )
         self._step = jax.jit(self._forward)
 
     def _random_init(self):

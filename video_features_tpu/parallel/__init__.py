@@ -1,0 +1,11 @@
+from .mesh import local_mesh, replicate, shard_along, sharded_apply
+from .pipeline import prefetch_to_device, shard_video_list
+
+__all__ = [
+    "local_mesh",
+    "replicate",
+    "shard_along",
+    "sharded_apply",
+    "prefetch_to_device",
+    "shard_video_list",
+]

@@ -1,0 +1,63 @@
+"""Device-mesh data parallelism for the clip pipeline.
+
+The reference's only parallel axis is inter-video data parallelism via one Python
+thread per GPU (``/root/reference/main.py:37-47``). The TPU-native design replaces
+threads with SPMD over a ``jax.sharding.Mesh``: a batch of clips is sharded along the
+leading axis across devices (``data`` axis over ICI), params are replicated, and a
+single jitted program runs everywhere. No collectives are semantically required for
+inference; XLA inserts only the initial shard/replicate transfers.
+
+Multi-host (DCN) scaling uses the same code: each host builds a mesh over its local
+devices and processes its shard of the *video list*
+(:func:`video_features_tpu.parallel.pipeline.shard_video_list`), mirroring the
+embarrassingly-parallel split the reference documents via ``gen_file_list.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def local_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over local devices: the clip-batch data-parallel axis."""
+    if devices is None:
+        devices = jax.local_devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def shard_along(mesh: Mesh, ndim: int, axis: int = 0) -> NamedSharding:
+    """NamedSharding that splits array axis ``axis`` across the data axis."""
+    spec = [None] * ndim
+    spec[axis] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def sharded_apply(mesh: Mesh, fn: Callable, batch_ndim: int, donate_batch: bool = True):
+    """jit ``fn(params, batch)`` with params replicated and batch sharded on axis 0.
+
+    The batch's leading axis must be divisible by the mesh size (callers pad with
+    :func:`video_features_tpu.extractors.base.pad_batch` — static shapes, one compile).
+    Donating the input batch lets XLA reuse its HBM for activations.
+    """
+    in_shardings = (replicate(mesh), shard_along(mesh, batch_ndim))
+    out_shardings = shard_along(mesh, 2)  # (N, feat) features stay row-sharded
+    return jax.jit(
+        fn,
+        in_shardings=in_shardings,
+        out_shardings=out_shardings,
+        donate_argnums=(1,) if donate_batch else (),
+    )

@@ -75,6 +75,9 @@ def resolve_params(
     allow_random: bool = False,
 ) -> dict:
     """Return the Flax param tree for model ``name`` per the resolution order above."""
+    if checkpoint_path and not os.path.exists(checkpoint_path):
+        # an explicit path must not silently degrade to random weights
+        raise FileNotFoundError(f"checkpoint_path {checkpoint_path!r} does not exist")
     paths = [checkpoint_path] if checkpoint_path else list(_candidates(name))
     for path in paths:
         if path is None or not os.path.exists(path):
