@@ -29,8 +29,8 @@ def _honor_jax_platforms_env() -> None:
 
         try:
             jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+        except Exception as e:
+            print(f"warning: could not apply JAX_PLATFORMS={want}: {e}", file=sys.stderr)
 
 
 def main(argv=None) -> int:

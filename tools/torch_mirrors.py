@@ -77,6 +77,110 @@ class ResNet50(nn.Module):
         return x if features else self.fc(x)
 
 
+# ---------------------------------------------------------------------------
+# I3D: functional mirror (no nn.Module graph) driven by the SAME spec table as
+# the Flax model (imported, not copied). Consumes/produces reference-named
+# state_dicts (conv3d_1a_7x7.conv3d.weight, mixed_3b.branch_1.0..., ...).
+# ---------------------------------------------------------------------------
+
+import torch.nn.functional as F
+
+from video_features_tpu.models.i3d import I3D_STEM as I3D_LAYERS
+
+
+def _tf_same_pad_5d(kernel, stride):
+    """F.pad arg (w_lo, w_hi, h_lo, h_hi, t_lo, t_hi) for the (k - s) SAME rule."""
+    flat = []
+    for k, s in zip(reversed(kernel), reversed(stride)):
+        p = max(k - s, 0)
+        flat += [p // 2, p - p // 2]
+    return flat
+
+
+def _i3d_unit(sd, prefix, x, kernel=(1, 1, 1), stride=(1, 1, 1), bn=True, act=True):
+    x = F.pad(x, _tf_same_pad_5d(kernel, stride))
+    x = F.conv3d(x, sd[f"{prefix}.conv3d.weight"], sd.get(f"{prefix}.conv3d.bias"),
+                 stride=tuple(stride))
+    if bn:
+        x = F.batch_norm(
+            x,
+            sd[f"{prefix}.batch3d.running_mean"],
+            sd[f"{prefix}.batch3d.running_var"],
+            sd[f"{prefix}.batch3d.weight"],
+            sd[f"{prefix}.batch3d.bias"],
+            training=False,
+        )
+    return F.relu(x) if act else x
+
+
+def _i3d_pool(x, kernel, stride):
+    x = F.pad(x, _tf_same_pad_5d(kernel, stride))
+    return F.max_pool3d(x, kernel, stride, ceil_mode=True)
+
+
+def i3d_forward(sd, x, features=True, num_classes=400):
+    """Functional I3D on (B, C, T, H, W); mirrors i3d_net.py numerics for parity."""
+    with torch.no_grad():
+        for layer in I3D_LAYERS:
+            kind, name = layer[0], layer[1]
+            if kind == "conv":
+                _, _, _, kernel, stride = layer
+                x = _i3d_unit(sd, name, x, kernel, stride)
+            elif kind == "pool":
+                _, _, kernel, stride = layer
+                x = _i3d_pool(x, kernel, stride)
+            else:
+                b0 = _i3d_unit(sd, f"{name}.branch_0", x)
+                b1 = _i3d_unit(sd, f"{name}.branch_1.1",
+                               _i3d_unit(sd, f"{name}.branch_1.0", x), (3, 3, 3))
+                b2 = _i3d_unit(sd, f"{name}.branch_2.1",
+                               _i3d_unit(sd, f"{name}.branch_2.0", x), (3, 3, 3))
+                b3 = _i3d_unit(sd, f"{name}.branch_3.1", _i3d_pool(x, (3, 3, 3), (1, 1, 1)))
+                x = torch.cat([b0, b1, b2, b3], dim=1)
+        # reference kernel (2,7,7) == (2, H, W) at the supported 224-crop geometry
+        x = F.avg_pool3d(x, (2, x.shape[3], x.shape[4]), (1, 1, 1))
+        if features:
+            return x.squeeze(3).squeeze(3).mean(2)
+        x = _i3d_unit(sd, "conv3d_0c_1x1", x, bn=False, act=False)
+        logits = x.squeeze(3).squeeze(3).mean(2)
+        return torch.softmax(logits, 1), logits
+
+
+def i3d_random_state_dict(modality="rgb", num_classes=400, seed=0):
+    """Reference-named random state_dict exercising converter + forward parity."""
+    g = torch.Generator().manual_seed(seed)
+
+    def unit(prefix, cin, cout, kernel, sd, bn=True, bias=False):
+        sd[f"{prefix}.conv3d.weight"] = torch.randn((cout, cin, *kernel), generator=g) * 0.05
+        if bias:
+            sd[f"{prefix}.conv3d.bias"] = torch.randn((cout,), generator=g) * 0.05
+        if bn:
+            sd[f"{prefix}.batch3d.weight"] = torch.rand((cout,), generator=g) + 0.5
+            sd[f"{prefix}.batch3d.bias"] = torch.randn((cout,), generator=g) * 0.05
+            sd[f"{prefix}.batch3d.running_mean"] = torch.randn((cout,), generator=g) * 0.05
+            sd[f"{prefix}.batch3d.running_var"] = torch.rand((cout,), generator=g) + 0.5
+
+    sd = {}
+    cin = {"rgb": 3, "flow": 2}[modality]
+    for layer in I3D_LAYERS:
+        kind, name = layer[0], layer[1]
+        if kind == "conv":
+            _, _, cout, kernel, _ = layer
+            unit(name, cin, cout, kernel, sd)
+            cin = cout
+        elif kind == "mixed":
+            c0, c1r, c1, c2r, c2, c3 = layer[2]
+            unit(f"{name}.branch_0", cin, c0, (1, 1, 1), sd)
+            unit(f"{name}.branch_1.0", cin, c1r, (1, 1, 1), sd)
+            unit(f"{name}.branch_1.1", c1r, c1, (3, 3, 3), sd)
+            unit(f"{name}.branch_2.0", cin, c2r, (1, 1, 1), sd)
+            unit(f"{name}.branch_2.1", c2r, c2, (3, 3, 3), sd)
+            unit(f"{name}.branch_3.1", cin, c3, (1, 1, 1), sd)
+            cin = c0 + c1 + c2 + c3
+    unit("conv3d_0c_1x1", 1024, num_classes, (1, 1, 1), sd, bn=False, bias=True)
+    return sd
+
+
 def random_init_(model: nn.Module, seed: int = 0) -> nn.Module:
     """Randomize all parameters and BN running stats so parity tests are non-trivial."""
     g = torch.Generator().manual_seed(seed)

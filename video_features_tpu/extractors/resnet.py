@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..io.video import open_video
 from ..models.resnet import ResNet50, preprocess_frames
+from ..parallel import prefetch_to_device
 from ..ops.image import np_center_crop_hwc, pil_edge_resize
 from ..utils.labels import show_predictions_on_dataset
 from ..weights.convert_torch import convert_resnet50
@@ -73,29 +74,33 @@ class ExtractResNet50(Extractor):
             keep_tmp_files=self.cfg.keep_tmp_files,
             transform=self._host_transform,
         )
-        vid_feats = []
         timestamps_ms = []
-        batch = []
+        valid_counts = []
 
-        def flush():
-            if not batch:
-                return
-            valid = len(batch)
-            u8 = pad_batch(np.stack(batch), self.batch_size)
-            feats = np.asarray(self._step(self.params, u8))[:valid]
+        def batches():
+            batch = []
+            for rgb, pos in frames:
+                timestamps_ms.append(pos)
+                batch.append(rgb)
+                if len(batch) == self.batch_size:
+                    valid_counts.append(len(batch))
+                    yield np.stack(batch)
+                    batch = []
+            if batch:  # partial tail batch (reference :139-141)
+                valid_counts.append(len(batch))
+                yield pad_batch(np.stack(batch), self.batch_size)
+
+        vid_feats = []
+        # decode of batch k+1 overlaps device compute of batch k
+        for i, device_batch in enumerate(
+            prefetch_to_device(batches(), depth=self.cfg.prefetch_depth)
+        ):
+            feats = np.asarray(self._step(self.params, device_batch))[: valid_counts[i]]
             vid_feats.append(feats)
             if self.cfg.show_pred:
                 fc = self.params["fc"]
                 logits = feats @ np.asarray(fc["kernel"]) + np.asarray(fc["bias"])
                 show_predictions_on_dataset(logits, "imagenet")
-            batch.clear()
-
-        for rgb, pos in frames:
-            timestamps_ms.append(pos)
-            batch.append(rgb)
-            if len(batch) == self.batch_size:
-                flush()
-        flush()  # partial tail batch (reference :139-141)
 
         feats = (
             np.concatenate(vid_feats, axis=0)

@@ -1,0 +1,102 @@
+"""Shared layers reproducing torch/TF numerics on channel-last layouts.
+
+These are the few primitives whose *exact* semantics decide feature parity with the
+reference: inference-mode BatchNorm, the reference's size-independent "TF-SAME"
+padding rule, and zero-padded ceil-mode max pooling
+(``/root/reference/models/i3d/i3d_src/i3d_net.py:8-34,108-120``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+BN_EPS = 1e-5  # torch BatchNorm default
+
+
+class TorchBatchNorm(nn.Module):
+    """Inference BatchNorm: y = (x - mean) / sqrt(var + eps) * scale + bias.
+
+    Running statistics live in ``params`` (converted weights, never updated), so the
+    whole model stays one frozen pytree. Affine math runs in fp32 then casts,
+    matching torch eval-mode numerics for bf16 compute.
+    """
+
+    eps: float = BN_EPS
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        mean = self.param("mean", nn.initializers.zeros, (c,), jnp.float32)
+        var = self.param("var", nn.initializers.ones, (c,), jnp.float32)
+        inv = jnp.asarray(scale, jnp.float32) / jnp.sqrt(jnp.asarray(var, jnp.float32) + self.eps)
+        y = (x.astype(jnp.float32) - mean) * inv + bias
+        return y.astype(self.dtype)
+
+
+def tf_same_pads(kernel: Sequence[int], stride: Sequence[int]) -> Tuple[Tuple[int, int], ...]:
+    """Per-axis (lo, hi) pads of the reference's TF-SAME rule: ``max(k - s, 0)``
+    split floor/ceil (``i3d_net.py:8-25``). Size-independent — equals true TF SAME
+    whenever the input is divisible by the stride, which holds for every I3D layer
+    at the 224/64 input geometry."""
+    pads = []
+    for k, s in zip(kernel, stride):
+        p = max(k - s, 0)
+        pads.append((p // 2, p - p // 2))
+    return tuple(pads)
+
+
+def max_pool_tf_same(
+    x: jnp.ndarray, kernel: Sequence[int], stride: Sequence[int]
+) -> jnp.ndarray:
+    """Zero-padded TF-SAME max pool with torch ceil_mode semantics on NDHWC/NHWC.
+
+    The reference zero-pads (not -inf: activations are post-ReLU, so zero is a
+    neutral element) then pools with ``ceil_mode=True`` (``i3d_net.py:108-120``).
+    Ceil-mode windows that run past the padded input ignore the overhang — expressed
+    here as extra -inf padding on the high side of each axis.
+    """
+    spatial = x.shape[1:-1]
+    zero_pads = tf_same_pads(kernel, stride)
+    cfg_pad = [(0, 0)]
+    cfg_win = [1]
+    cfg_str = [1]
+    for size, k, s, (lo, hi) in zip(spatial, kernel, stride, zero_pads):
+        padded = size + lo + hi
+        n_out = max(math.ceil((padded - k) / s), 0) + 1
+        extra = (n_out - 1) * s + k - padded
+        cfg_pad.append((0, max(extra, 0)))
+        cfg_win.append(k)
+        cfg_str.append(s)
+    cfg_pad.append((0, 0))
+    cfg_win.append(1)
+    cfg_str.append(1)
+
+    x = jnp.pad(
+        x,
+        [(0, 0)] + [(lo, hi) for lo, hi in zero_pads] + [(0, 0)],
+        constant_values=0,
+    )
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        tuple(cfg_win),
+        tuple(cfg_str),
+        cfg_pad,
+    )
+
+
+def avg_pool_valid(x: jnp.ndarray, kernel: Sequence[int], stride: Sequence[int]) -> jnp.ndarray:
+    """VALID average pool on channel-last input (torch ``AvgPool3d`` semantics)."""
+    window = (1, *kernel, 1)
+    strides = (1, *stride, 1)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, "VALID")
+    return summed / math.prod(kernel)

@@ -81,3 +81,40 @@ def convert_resnet50(state_dict: Mapping) -> dict:
         set_path(params, ("fc", "kernel"), linear_kernel(sd["fc.weight"]))
         set_path(params, ("fc", "bias"), np.asarray(sd["fc.bias"]))
     return params
+
+
+def convert_i3d(state_dict: Mapping) -> dict:
+    """Reference I3D checkpoint (``i3d_rgb.pt``/``i3d_flow.pt`` state_dict naming,
+    ``/root/reference/models/i3d/i3d_src/i3d_net.py``) → :class:`models.i3d.I3D`
+    params.
+
+    The Flax module names mirror the torch names, with one twist: torch flattens
+    ``mixed_3b.branch_1.0`` while the Flax submodule is literally named
+    ``branch_1.0`` — so ``branch_<i>.<j>`` token pairs re-join into one path element.
+    """
+    sd = to_numpy_state_dict(state_dict)
+    params: dict = {}
+    for key, value in sd.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        tokens = key.split(".")
+        merged = []
+        i = 0
+        while i < len(tokens):
+            if tokens[i].startswith("branch_") and i + 1 < len(tokens) and tokens[i + 1].isdigit():
+                merged.append(tokens[i] + "." + tokens[i + 1])
+                i += 2
+            else:
+                merged.append(tokens[i])
+                i += 1
+        *path, module, leaf = merged
+        if module == "conv3d":
+            if leaf == "weight":
+                set_path(params, (*path, "conv3d", "kernel"), conv3d_kernel(value))
+            else:
+                set_path(params, (*path, "conv3d", "bias"), value)
+        elif module == "batch3d":
+            set_path(params, (*path, "batch3d", _BN_MAP[leaf]), value)
+        else:
+            raise ValueError(f"unrecognized I3D checkpoint key: {key}")
+    return params
