@@ -1,0 +1,73 @@
+"""JAX PWC-Net numerical parity vs a torch functional mirror (random weights)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from torch_mirrors import _pwc_corr, _pwc_warp, pwc_random_state_dict, pwc_torch_forward
+from video_features_tpu.models.pwc import (
+    correlation_81,
+    pwc_forward,
+    pwc_init_params,
+)
+from video_features_tpu.ops.warp import warp_backward
+from video_features_tpu.weights.convert_torch import convert_pwc
+
+
+@pytest.fixture(scope="module")
+def converted():
+    sd = pwc_random_state_dict(seed=11)
+    return sd, convert_pwc(sd)
+
+
+def test_param_tree_matches_init_structure(converted):
+    _, params = converted
+    init = pwc_init_params(seed=0)
+    p1 = {jax.tree_util.keystr(p) for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]}
+    p2 = {jax.tree_util.keystr(p) for p, _ in jax.tree_util.tree_flatten_with_path(init)[0]}
+    assert p1 == p2
+
+
+def test_correlation_matches_torch():
+    rng = np.random.default_rng(0)
+    f1 = rng.standard_normal((2, 10, 12, 7)).astype(np.float32)
+    f2 = rng.standard_normal((2, 10, 12, 7)).astype(np.float32)
+    ref = _pwc_corr(torch.from_numpy(f1).permute(0, 3, 1, 2),
+                    torch.from_numpy(f2).permute(0, 3, 1, 2)).permute(0, 2, 3, 1).numpy()
+    out = np.asarray(correlation_81(jnp.asarray(f1), jnp.asarray(f2)))
+    assert out.shape == (2, 10, 12, 81)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_warp_matches_torch():
+    rng = np.random.default_rng(1)
+    img = rng.standard_normal((2, 8, 9, 5)).astype(np.float32)
+    flow = (rng.standard_normal((2, 8, 9, 2)) * 2).astype(np.float32)
+    ref = _pwc_warp(torch.from_numpy(img).permute(0, 3, 1, 2),
+                    torch.from_numpy(flow).permute(0, 3, 1, 2)).permute(0, 2, 3, 1).numpy()
+    out = np.asarray(warp_backward(jnp.asarray(img), jnp.asarray(flow)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_flow_parity(converted):
+    sd, params = converted
+    rng = np.random.default_rng(0)
+    # non-/64 size exercises both bilinear resizes (in and out)
+    img1 = rng.uniform(0, 255, (1, 96, 120, 3)).astype(np.float32)
+    img2 = rng.uniform(0, 255, (1, 96, 120, 3)).astype(np.float32)
+    ref = pwc_torch_forward(
+        sd, torch.from_numpy(img1).permute(0, 3, 1, 2), torch.from_numpy(img2).permute(0, 3, 1, 2)
+    ).permute(0, 2, 3, 1).numpy()
+    out = np.asarray(pwc_forward(params, jnp.asarray(img1), jnp.asarray(img2)))
+    assert out.shape == ref.shape == (1, 96, 120, 2)
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=2e-3)
+    cos = np.sum(out * ref) / (np.linalg.norm(out) * np.linalg.norm(ref))
+    assert cos > 1 - 1e-5

@@ -118,7 +118,7 @@ def _i3d_pool(x, kernel, stride):
     return F.max_pool3d(x, kernel, stride, ceil_mode=True)
 
 
-def i3d_forward(sd, x, features=True, num_classes=400):
+def i3d_forward(sd, x, features=True):
     """Functional I3D on (B, C, T, H, W); mirrors i3d_net.py numerics for parity."""
     with torch.no_grad():
         for layer in I3D_LAYERS:
@@ -179,6 +179,255 @@ def i3d_random_state_dict(modality="rgb", num_classes=400, seed=0):
             cin = c0 + c1 + c2 + c3
     unit("conv3d_0c_1x1", 1024, num_classes, (1, 1, 1), sd, bn=False, bias=True)
     return sd
+
+
+# ---------------------------------------------------------------------------
+# RAFT: functional torch mirror of the reference semantics (raft_src/), driven
+# by the SAME shape spec as the JAX model (imported). Parity oracle for
+# video_features_tpu.models.raft.
+# ---------------------------------------------------------------------------
+
+from video_features_tpu.models.raft import _conv_shapes as raft_conv_shapes
+
+
+def raft_random_state_dict(seed: int = 0):
+    """Reference-named random state_dict (no 'module.' prefix)."""
+    g = torch.Generator().manual_seed(seed)
+    sd = {}
+    for name, shape in raft_conv_shapes().items():
+        if len(shape) == 1:  # batch norm
+            c = shape[0]
+            sd[f"{name}.weight"] = torch.rand(c, generator=g) + 0.5
+            sd[f"{name}.bias"] = torch.randn(c, generator=g) * 0.05
+            sd[f"{name}.running_mean"] = torch.randn(c, generator=g) * 0.05
+            sd[f"{name}.running_var"] = torch.rand(c, generator=g) + 0.5
+        else:
+            cin, cout, kh, kw = shape
+            sd[f"{name}.weight"] = torch.randn((cout, cin, kh, kw), generator=g) * 0.05
+            sd[f"{name}.bias"] = torch.randn(cout, generator=g) * 0.05
+    return sd
+
+
+def _rconv(sd, name, x, stride=1, padding=0):
+    return F.conv2d(x, sd[f"{name}.weight"], sd[f"{name}.bias"], stride, padding)
+
+
+def _rnorm(sd, name, x, kind):
+    if kind == "instance":
+        return F.instance_norm(x)
+    if kind == "batch":
+        return F.batch_norm(x, sd[f"{name}.running_mean"], sd[f"{name}.running_var"],
+                            sd[f"{name}.weight"], sd[f"{name}.bias"], training=False)
+    return x
+
+
+def _raft_encoder(sd, prefix, x, kind):
+    x = F.relu(_rnorm(sd, f"{prefix}.norm1", _rconv(sd, f"{prefix}.conv1", x, 2, 3), kind))
+    for stage, stride in (("layer1", 1), ("layer2", 2), ("layer3", 2)):
+        for blk in (0, 1):
+            s = stride if blk == 0 else 1
+            p = f"{prefix}.{stage}.{blk}"
+            y = F.relu(_rnorm(sd, f"{p}.norm1", _rconv(sd, f"{p}.conv1", x, s, 1), kind))
+            y = F.relu(_rnorm(sd, f"{p}.norm2", _rconv(sd, f"{p}.conv2", y, 1, 1), kind))
+            if s != 1:
+                x = _rnorm(sd, f"{p}.norm3", _rconv(sd, f"{p}.downsample.0", x, s, 0), kind)
+            x = F.relu(x + y)
+    return _rconv(sd, f"{prefix}.conv2", x, 1, 0)
+
+
+def _raft_bilinear(img, coords):
+    """Reference bilinear_sampler: pixel coords → grid_sample align_corners=True."""
+    H, W = img.shape[-2:]
+    xg = 2 * coords[..., 0] / (W - 1) - 1
+    yg = 2 * coords[..., 1] / (H - 1) - 1
+    return F.grid_sample(img, torch.stack([xg, yg], -1), align_corners=True)
+
+
+def raft_torch_forward(sd, image1, image2, iters=20):
+    """(B, 3, H, W) float RGB [0,255], H,W /8 → (B, 2, H, W) flow. Mirrors
+    raft.py:115-174 numerics including the delta-grid dx/dy swap (corr.py:37-43)."""
+    with torch.no_grad():
+        x1 = 2 * (image1 / 255.0) - 1.0
+        x2 = 2 * (image2 / 255.0) - 1.0
+        f1 = _raft_encoder(sd, "fnet", x1, "instance").float()
+        f2 = _raft_encoder(sd, "fnet", x2, "instance").float()
+
+        B, D, H, W = f1.shape
+        corr = torch.matmul(f1.view(B, D, -1).transpose(1, 2), f2.view(B, D, -1))
+        corr = corr.view(B * H * W, 1, H, W) / (D ** 0.5)
+        pyramid = [corr]
+        for _ in range(3):
+            corr = F.avg_pool2d(corr, 2, stride=2)
+            pyramid.append(corr)
+
+        cnet = _raft_encoder(sd, "cnet", x1, "batch")
+        net, inp = torch.tanh(cnet[:, :128]), F.relu(cnet[:, 128:])
+
+        ys, xs = torch.meshgrid(torch.arange(H), torch.arange(W), indexing="ij")
+        coords0 = torch.stack([xs, ys], 0).float()[None].repeat(B, 1, 1, 1)
+        coords1 = coords0.clone()
+
+        r = 4
+        d = torch.linspace(-r, r, 2 * r + 1)
+        # reference delta swap: grid axis 0 carries the x displacement
+        delta = torch.stack(torch.meshgrid(d, d, indexing="ij"), dim=-1)  # (9,9,(dx,dy))
+
+        for _ in range(iters):
+            pts = coords1.permute(0, 2, 3, 1).reshape(B * H * W, 1, 1, 2)
+            out = []
+            for i, c in enumerate(pyramid):
+                lvl = pts / 2 ** i + delta.view(1, 9, 9, 2)
+                smp = _raft_bilinear(c, lvl)  # (BHW, 1, 9, 9)
+                out.append(smp.view(B, H, W, 81))
+            corr_feat = torch.cat(out, -1).permute(0, 3, 1, 2)
+
+            flow = coords1 - coords0
+            cor = F.relu(_rconv(sd, "update_block.encoder.convc1", corr_feat))
+            cor = F.relu(_rconv(sd, "update_block.encoder.convc2", cor, 1, 1))
+            flo = F.relu(_rconv(sd, "update_block.encoder.convf1", flow, 1, 3))
+            flo = F.relu(_rconv(sd, "update_block.encoder.convf2", flo, 1, 1))
+            mot = F.relu(_rconv(sd, "update_block.encoder.conv", torch.cat([cor, flo], 1), 1, 1))
+            x = torch.cat([inp, torch.cat([mot, flow], 1)], 1)
+
+            h = net
+            for sfx, pad in (("1", (0, 2)), ("2", (2, 0))):
+                hx = torch.cat([h, x], 1)
+                z = torch.sigmoid(F.conv2d(hx, sd[f"update_block.gru.convz{sfx}.weight"],
+                                           sd[f"update_block.gru.convz{sfx}.bias"], 1, pad))
+                rr = torch.sigmoid(F.conv2d(hx, sd[f"update_block.gru.convr{sfx}.weight"],
+                                            sd[f"update_block.gru.convr{sfx}.bias"], 1, pad))
+                q = torch.tanh(F.conv2d(torch.cat([rr * h, x], 1),
+                                        sd[f"update_block.gru.convq{sfx}.weight"],
+                                        sd[f"update_block.gru.convq{sfx}.bias"], 1, pad))
+                h = (1 - z) * h + z * q
+            net = h
+            delta_flow = _rconv(sd, "update_block.flow_head.conv2",
+                                F.relu(_rconv(sd, "update_block.flow_head.conv1", net, 1, 1)), 1, 1)
+            coords1 = coords1 + delta_flow
+
+        mask = 0.25 * _rconv(sd, "update_block.mask.2",
+                             F.relu(_rconv(sd, "update_block.mask.0", net, 1, 1)))
+        # convex upsample (raft.py:100-111)
+        flow = coords1 - coords0
+        m = mask.view(B, 1, 9, 8, 8, H, W)
+        m = torch.softmax(m, dim=2)
+        up = F.unfold(8 * flow, [3, 3], padding=1).view(B, 2, 9, 1, 1, H, W)
+        up = torch.sum(m * up, dim=2).permute(0, 1, 4, 2, 5, 3)
+        return up.reshape(B, 2, 8 * H, 8 * W)
+
+
+# ---------------------------------------------------------------------------
+# PWC-Net: functional torch mirror of the reference semantics (pwc_src/), driven
+# by the SAME shape spec as the JAX model. torch-1.2 grid_sample semantics
+# (align_corners=True) per the pinned conda_env_pwc.yml.
+# ---------------------------------------------------------------------------
+
+from video_features_tpu.models.pwc import DEC_BACKWARD, LEVEL_NAMES, pwc_conv_shapes
+
+
+def pwc_random_state_dict(seed: int = 0):
+    g = torch.Generator().manual_seed(seed)
+    sd = {}
+    for name, shape in pwc_conv_shapes().items():
+        if shape[0] == "T":
+            _, cin, cout, kh, kw = shape
+            w = torch.randn((cin, cout, kh, kw), generator=g) * 0.05
+        else:
+            cin, cout, kh, kw = shape
+            w = torch.randn((cout, cin, kh, kw), generator=g) * 0.05
+        sd[f"{name}.weight"] = w
+        sd[f"{name}.bias"] = torch.randn(cout, generator=g) * 0.05
+    return sd
+
+
+def _pwc_corr(f1, f2):
+    """81-channel channel-mean cost volume, k = (dy+4)*9 + (dx+4) (correlation.py)."""
+    B, C, H, W = f1.shape
+    f2p = F.pad(f2, (4, 4, 4, 4))
+    out = []
+    for dy in range(-4, 5):
+        for dx in range(-4, 5):
+            shifted = f2p[:, :, 4 + dy : 4 + dy + H, 4 + dx : 4 + dx + W]
+            out.append((f1 * shifted).mean(1))
+    return torch.stack(out, 1)
+
+
+def _pwc_warp(x, flow):
+    """Backward warp with ones-mask thresholding (pwc_net.py:23-41)."""
+    B, C, H, W = x.shape
+    gx = torch.linspace(-1, 1, W).view(1, 1, 1, W).expand(B, 1, H, W)
+    gy = torch.linspace(-1, 1, H).view(1, 1, H, 1).expand(B, 1, H, W)
+    grid = torch.cat([gx, gy], 1)
+    nflow = torch.cat([flow[:, :1] / ((W - 1) / 2), flow[:, 1:] / ((H - 1) / 2)], 1)
+    xm = torch.cat([x, torch.ones(B, 1, H, W)], 1)
+    out = F.grid_sample(xm, (grid + nflow).permute(0, 2, 3, 1),
+                        mode="bilinear", padding_mode="zeros", align_corners=True)
+    mask = out[:, -1:]
+    mask = (mask > 0.999).float()
+    return out[:, :-1] * mask
+
+
+def _pwc_pyramid(sd, x):
+    feats = []
+    for name in ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv", "moduleSix"):
+        p = f"moduleExtractor.{name}"
+        x = F.leaky_relu(F.conv2d(x, sd[f"{p}.0.weight"], sd[f"{p}.0.bias"], 2, 1), 0.1)
+        x = F.leaky_relu(F.conv2d(x, sd[f"{p}.2.weight"], sd[f"{p}.2.bias"], 1, 1), 0.1)
+        x = F.leaky_relu(F.conv2d(x, sd[f"{p}.4.weight"], sd[f"{p}.4.bias"], 1, 1), 0.1)
+        feats.append(x)
+    return feats
+
+
+def pwc_torch_forward(sd, image1, image2):
+    """(B, 3, H, W) float RGB [0,255] → (B, 2, H, W) flow (pwc_net.py:226-263)."""
+    import math
+
+    with torch.no_grad():
+        B, C, H, W = image1.shape
+        x1 = image1[:, [2, 1, 0]] / 255.0
+        x2 = image2[:, [2, 1, 0]] / 255.0
+        H64 = int(math.floor(math.ceil(H / 64.0) * 64.0))
+        W64 = int(math.floor(math.ceil(W / 64.0) * 64.0))
+        if (H64, W64) != (H, W):
+            x1 = F.interpolate(x1, size=(H64, W64), mode="bilinear", align_corners=False)
+            x2 = F.interpolate(x2, size=(H64, W64), mode="bilinear", align_corners=False)
+
+        pyr1 = _pwc_pyramid(sd, x1)
+        pyr2 = _pwc_pyramid(sd, x2)
+
+        est = None
+        for level in (6, 5, 4, 3, 2):
+            mod = LEVEL_NAMES[level]
+            f1, f2 = pyr1[level - 1], pyr2[level - 1]
+            if est is None:
+                feat = F.leaky_relu(_pwc_corr(f1, f2), 0.1)
+            else:
+                flow = F.conv_transpose2d(est["flow"], sd[f"{mod}.moduleUpflow.weight"],
+                                          sd[f"{mod}.moduleUpflow.bias"], 2, 1)
+                upfeat = F.conv_transpose2d(est["feat"], sd[f"{mod}.moduleUpfeat.weight"],
+                                            sd[f"{mod}.moduleUpfeat.bias"], 2, 1)
+                warped = _pwc_warp(f2, flow * DEC_BACKWARD[level])
+                vol = F.leaky_relu(_pwc_corr(f1, warped), 0.1)
+                feat = torch.cat([vol, f1, flow, upfeat], 1)
+            for name in ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv"):
+                y = F.leaky_relu(F.conv2d(feat, sd[f"{mod}.{name}.0.weight"],
+                                          sd[f"{mod}.{name}.0.bias"], 1, 1), 0.1)
+                feat = torch.cat([y, feat], 1)
+            flow = F.conv2d(feat, sd[f"{mod}.moduleSix.0.weight"], sd[f"{mod}.moduleSix.0.bias"], 1, 1)
+            est = {"flow": flow, "feat": feat}
+
+        x = est["feat"]
+        for idx, d in zip(("0", "2", "4", "6", "8", "10"), (1, 2, 4, 8, 16, 1)):
+            p = f"moduleRefiner.moduleMain.{idx}"
+            x = F.leaky_relu(F.conv2d(x, sd[f"{p}.weight"], sd[f"{p}.bias"], 1, d, d), 0.1)
+        refined = F.conv2d(x, sd["moduleRefiner.moduleMain.12.weight"],
+                           sd["moduleRefiner.moduleMain.12.bias"], 1, 1)
+
+        temp = est["flow"] + refined
+        flow = 20.0 * F.interpolate(temp, size=(H, W), mode="bilinear", align_corners=False)
+        flow[:, 0] *= float(W) / float(W64)
+        flow[:, 1] *= float(H) / float(H64)
+        return flow
 
 
 def random_init_(model: nn.Module, seed: int = 0) -> nn.Module:

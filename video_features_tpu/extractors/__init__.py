@@ -22,12 +22,9 @@ def get_extractor(cfg):
     if ft == "i3d":
         from .i3d import ExtractI3D
         return ExtractI3D(cfg)
-    if ft == "raft":
-        from .raft import ExtractRAFT
-        return ExtractRAFT(cfg)
-    if ft == "pwc":
-        from .pwc import ExtractPWC
-        return ExtractPWC(cfg)
+    if ft in ("raft", "pwc"):
+        from .flow import ExtractFlow
+        return ExtractFlow(cfg)
     if ft == "vggish":
         from .vggish import ExtractVGGish
         return ExtractVGGish(cfg)

@@ -1,0 +1,192 @@
+"""PWC-Net optical flow in JAX (NHWC, functional).
+
+Behavioral spec — ``/root/reference/models/pwc/pwc_src/pwc_net.py``:
+- Input RGB in [0, 255]; the net flips to BGR and scales /255 (``:229-231``) because
+  the pretrained weights are BGR-native.
+- Bilinear resize (align_corners=False) to /64-multiple sizes (``:241-245``).
+- 6-level feature pyramid, 3 convs per level, LeakyReLU 0.1 (``:44-110``).
+- Coarse-to-fine decoders at levels 6→2 (``:112-187``): 81-channel cost volume
+  (9×9 displacement window, zero-padded, channel-mean — the CUDA kernel semantics of
+  ``correlation.py:44-112``: channel k ↔ (dy=k//9−4, dx=k%9−4)), LeakyReLU'd;
+  below level 6 the second feature map is backward-warped by the upsampled flow
+  scaled per level (0.625/1.25/2.5/5.0), with the partial-tap zeroing mask
+  (``:23-41``); DenseNet-style conv block (new features concatenated in front).
+- Dilated refiner on the level-2 feature tail (``:189-210``).
+- Output: 20 × bilinear resize of (flow₂ + refinement) to the *original* size, u
+  scaled by W/W₆₄, v by H/H₆₄ (``:256-261``).
+
+The cost volume here is 81 shifted elementwise products reduced over channels —
+XLA fuses this into a handful of HBM-friendly passes; a Pallas kernel slot exists in
+:mod:`video_features_tpu.ops.pallas_corr` for the hand-tiled version.
+
+Functional over a param pytree (torch checkpoint names, e.g.
+``moduleExtractor.moduleOne.0`` — see
+:func:`video_features_tpu.weights.convert_torch.convert_pwc`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.nnf import conv2d, conv2d_transpose, leaky_relu
+from ..ops.warp import resize_bilinear_torch, warp_backward
+
+CORR_RADIUS = 4
+CORR_CHANNELS = (2 * CORR_RADIUS + 1) ** 2  # 81
+
+# pyramid level channel counts (level 1..6)
+PYR_CHANNELS = (16, 32, 64, 96, 128, 196)
+# decoder input channels per level: 81 + fmap + 2 flow + 2 upfeat (level 6: corr only)
+DEC_CURRENT = {6: 81, 5: 81 + 128 + 4, 4: 81 + 96 + 4, 3: 81 + 64 + 4, 2: 81 + 32 + 4}
+DEC_BACKWARD = {5: 0.625, 4: 1.25, 3: 2.5, 2: 5.0}
+DENSE_OUT = (128, 128, 96, 64, 32)  # moduleOne..moduleFiv
+LEVEL_NAMES = {2: "moduleTwo", 3: "moduleThr", 4: "moduleFou", 5: "moduleFiv", 6: "moduleSix"}
+
+
+def correlation_81(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
+    """Channel-mean cost volume over the 9×9 displacement window.
+
+    out[b, y, x, k] = mean_c f1[b, y, x, c] · f2[b, y+dy, x+dx, c], zero-padded,
+    k = (dy+4)·9 + (dx+4) — the reference CUDA kernel's channel order
+    (``correlation.py:79-81``).
+    """
+    b, h, w, c = f1.shape
+    r = CORR_RADIUS
+    f2p = jnp.pad(f2, ((0, 0), (r, r), (r, r), (0, 0)))
+    f1 = f1.astype(jnp.float32)
+    taps = []
+    for dy in range(-r, r + 1):
+        for dx in range(-r, r + 1):
+            shifted = f2p[:, r + dy : r + dy + h, r + dx : r + dx + w, :].astype(jnp.float32)
+            taps.append(jnp.mean(f1 * shifted, axis=-1))
+    return jnp.stack(taps, axis=-1)
+
+
+def _pyramid(p: Dict, x: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """6-level feature pyramid (pwc_net.py:44-110); 3 convs per level."""
+    names = ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv", "moduleSix")
+    feats = []
+    for name in names:
+        lvl = p[name]
+        x = leaky_relu(conv2d(lvl["0"], x, 2, 1))
+        x = leaky_relu(conv2d(lvl["2"], x, 1, 1))
+        x = leaky_relu(conv2d(lvl["4"], x, 1, 1))
+        feats.append(x)
+    return tuple(feats)
+
+
+def _decoder(p: Dict, level: int, f1: jnp.ndarray, f2: jnp.ndarray, prev):
+    """One coarse-to-fine stage (pwc_net.py:152-187)."""
+    if prev is None:
+        volume = leaky_relu(correlation_81(f1, f2))
+        feat = volume
+    else:
+        flow = conv2d_transpose(p["moduleUpflow"], prev["flow"])
+        upfeat = conv2d_transpose(p["moduleUpfeat"], prev["feat"])
+        warped = warp_backward(f2, flow * DEC_BACKWARD[level])
+        volume = leaky_relu(correlation_81(f1, warped))
+        feat = jnp.concatenate([volume, f1, flow, upfeat], axis=-1)
+
+    for name in ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv"):
+        feat = jnp.concatenate([leaky_relu(conv2d(p[name]["0"], feat, 1, 1)), feat], axis=-1)
+    flow = conv2d(p["moduleSix"]["0"], feat, 1, 1)
+    return {"flow": flow, "feat": feat}
+
+
+def _refiner(p: Dict, feat: jnp.ndarray) -> jnp.ndarray:
+    """Dilated context network (pwc_net.py:189-210)."""
+    dilations = (1, 2, 4, 8, 16, 1)
+    x = feat
+    for idx, d in zip(("0", "2", "4", "6", "8", "10"), dilations):
+        x = leaky_relu(conv2d(p[idx], x, 1, d, dilation=d))
+    return conv2d(p["12"], x, 1, 1)
+
+
+def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray) -> jnp.ndarray:
+    """Flow frame1→frame2. Inputs (B, H, W, 3) float RGB [0, 255], any size.
+    Returns (B, H, W, 2) flow in input-resolution pixels."""
+    b, h, w, _ = image1.shape
+    x1 = image1[..., ::-1].astype(jnp.float32) / 255.0  # RGB → BGR (pwc_net.py:230)
+    x2 = image2[..., ::-1].astype(jnp.float32) / 255.0
+
+    h64 = int(math.floor(math.ceil(h / 64.0) * 64.0))
+    w64 = int(math.floor(math.ceil(w / 64.0) * 64.0))
+    if (h64, w64) != (h, w):
+        x1 = resize_bilinear_torch(x1, h64, w64)
+        x2 = resize_bilinear_torch(x2, h64, w64)
+
+    pyr1 = _pyramid(params["moduleExtractor"], x1)
+    pyr2 = _pyramid(params["moduleExtractor"], x2)
+
+    est = None
+    for level in (6, 5, 4, 3, 2):
+        est = _decoder(params[LEVEL_NAMES[level]], level,
+                       pyr1[level - 1], pyr2[level - 1], est)
+
+    flow = est["flow"] + _refiner(params["moduleRefiner"]["moduleMain"], est["feat"])
+    flow = 20.0 * resize_bilinear_torch(flow, h, w)
+    scale = jnp.asarray([w / w64, h / h64], jnp.float32)
+    return flow * scale
+
+
+# ---------------------------------------------------------------------------
+# Shapes / random init. conv: (cin, cout, kh, kw); 'T' prefix marks transpose convs
+# whose torch weights are laid out (in, out, kh, kw).
+# ---------------------------------------------------------------------------
+
+def pwc_conv_shapes() -> Dict[str, Tuple]:
+    shapes: Dict[str, Tuple] = {}
+    cin = 3
+    for name, cout in zip(
+        ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv", "moduleSix"),
+        PYR_CHANNELS,
+    ):
+        shapes[f"moduleExtractor.{name}.0"] = (cin, cout, 3, 3)
+        shapes[f"moduleExtractor.{name}.2"] = (cout, cout, 3, 3)
+        shapes[f"moduleExtractor.{name}.4"] = (cout, cout, 3, 3)
+        cin = cout
+
+    for level in (6, 5, 4, 3, 2):
+        mod = LEVEL_NAMES[level]
+        current = DEC_CURRENT[level]
+        if level < 6:
+            prev_feat = DEC_CURRENT[level + 1] + sum(DENSE_OUT)
+            shapes[f"{mod}.moduleUpflow"] = ("T", 2, 2, 4, 4)
+            shapes[f"{mod}.moduleUpfeat"] = ("T", prev_feat, 2, 4, 4)
+        ch = current
+        for name, cout in zip(("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv"),
+                              DENSE_OUT):
+            shapes[f"{mod}.{name}.0"] = (ch, cout, 3, 3)
+            ch += cout
+        shapes[f"{mod}.moduleSix.0"] = (ch, 2, 3, 3)
+
+    ch = DEC_CURRENT[2] + sum(DENSE_OUT)
+    for idx, (cout, _d) in zip(("0", "2", "4", "6", "8", "10", "12"),
+                               ((128, 1), (128, 2), (128, 4), (96, 8), (64, 16), (32, 1), (2, 1))):
+        shapes[f"moduleRefiner.moduleMain.{idx}"] = (ch, cout, 3, 3)
+        ch = cout
+    return shapes
+
+
+def pwc_init_params(seed: int = 0) -> Dict:
+    """Deterministic random param pytree with checkpoint-identical structure."""
+    rng = np.random.default_rng(seed)
+    tree: Dict = {}
+    for name, shape in pwc_conv_shapes().items():
+        if shape[0] == "T":
+            _, cin, cout, kh, kw = shape
+        else:
+            cin, cout, kh, kw = shape
+        node = tree
+        parts = name.split(".")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = {
+            "kernel": (rng.standard_normal((kh, kw, cin, cout)) * 0.05).astype(np.float32),
+            "bias": (rng.standard_normal(cout) * 0.05).astype(np.float32),
+        }
+    return tree

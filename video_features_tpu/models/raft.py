@@ -1,0 +1,285 @@
+"""RAFT optical flow in JAX (NHWC, functional, scan-tied update loop).
+
+Behavioral spec — ``/root/reference/models/raft/raft_src/``:
+- Input pair normalized ``2·(x/255) − 1`` (``raft.py:118-119``); images pre-padded to
+  /8 multiples by the extractor (replicate, sintel split — ``raft.py:27-44``).
+- ``fnet`` (instance norm) → 256-d features at 1/8 res for both frames;
+  ``cnet`` (eval batch norm) → 128 tanh hidden + 128 relu context (``raft.py:127-143``).
+- All-pairs correlation ``⟨f1, f2⟩/√256`` pooled into a 4-level pyramid
+  (``corr.py:12-27,52-60``); each iteration gathers a 9×9 bilinear window per level
+  at the current flow (``corr.py:29-50``) — torch's channel order (the reference
+  swaps dx/dy when building the delta grid, ``corr.py:37-43``) is reproduced exactly
+  because the update-block weights were trained against it.
+- 20 iterations of motion encoder + separable ConvGRU + flow head
+  (``update.py:37-139``, ``raft.py:151-168``) — here one ``lax.scan`` body.
+- Convex upsampling ×8 with a learned 9-tap softmax mask (``raft.py:100-111``),
+  computed ONCE after the loop (the reference recomputes it every iteration and
+  discards all but the last in test mode — identical output, 20× less upsample work).
+
+Weight-tied loops are why this model is functional over a param pytree instead of a
+linen module: ``lax.scan`` over pure functions keeps the compiled HLO one body long.
+Param tree names mirror the torch checkpoint (minus the ``module.`` prefix) so
+conversion is mechanical (:func:`video_features_tpu.weights.convert_torch.convert_raft`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.nnf import avg_pool2d, batch_norm_eval, conv2d, instance_norm
+from ..ops.warp import bilinear_sample, coords_grid
+
+HIDDEN_DIM = 128
+CONTEXT_DIM = 128
+CORR_LEVELS = 4
+CORR_RADIUS = 4
+ITERS = 20  # reference inference default (raft.py:115)
+
+# (name, cin, cout, kernel, stride, pad) for plain convs; residual layers described
+# structurally in _encoder below.
+ENCODER_DIMS = (64, 64, 96, 128)  # stem, layer1, layer2, layer3
+
+
+def _relu(x):
+    return jnp.maximum(x, 0)
+
+
+def _norm(p: dict, x: jnp.ndarray, kind: str, name: str) -> jnp.ndarray:
+    if kind == "instance":
+        return instance_norm(x)
+    if kind == "batch":
+        return batch_norm_eval(p[name], x)
+    return x
+
+
+def _residual_block(p: dict, x: jnp.ndarray, kind: str, stride: int) -> jnp.ndarray:
+    y = _relu(_norm(p, conv2d(p["conv1"], x, stride, 1), kind, "norm1"))
+    y = _relu(_norm(p, conv2d(p["conv2"], y, 1, 1), kind, "norm2"))
+    if stride != 1:
+        x = _norm(p, conv2d(p["downsample.0"], x, stride, 0), kind, "norm3")
+    return _relu(x + y)
+
+
+def _encoder(p: dict, x: jnp.ndarray, kind: str) -> jnp.ndarray:
+    """BasicEncoder (extractor.py:118-192): 7×7/2 stem + 3 residual stages + 1×1."""
+    x = _relu(_norm(p, conv2d(p["conv1"], x, 2, 3), kind, "norm1"))
+    for stage, stride in (("layer1", 1), ("layer2", 2), ("layer3", 2)):
+        x = _residual_block(p[f"{stage}.0"], x, kind, stride)
+        x = _residual_block(p[f"{stage}.1"], x, kind, 1)
+    return conv2d(p["conv2"], x, 1, 0)
+
+
+def _build_pyramid(f1: jnp.ndarray, f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """All-pairs correlation volume pooled over target resolution (corr.py:12-27)."""
+    b, h, w, d = f1.shape
+    corr = jnp.einsum("bijc,bklc->bijkl", f1.astype(jnp.float32), f2.astype(jnp.float32))
+    corr = corr / math.sqrt(d)
+    corr = corr.reshape(b * h * w, h, w, 1)
+    pyramid = [corr]
+    for _ in range(CORR_LEVELS - 1):
+        corr = avg_pool2d(corr, 2, 2)
+        pyramid.append(corr)
+    return tuple(pyramid)
+
+
+def _lookup(pyramid, coords: jnp.ndarray) -> jnp.ndarray:
+    """9×9 bilinear window per level around the current correspondence.
+
+    Reproduces the reference's delta-grid axis swap (corr.py:37-43): grid position
+    (i, j) samples displacement (δ_i in x, δ_j in y), flattened i-major into 81
+    channels per level.
+    """
+    b, h, w, _ = coords.shape
+    r = CORR_RADIUS
+    d = jnp.arange(-r, r + 1, dtype=jnp.float32)
+    dx = jnp.broadcast_to(d[:, None], (2 * r + 1, 2 * r + 1))  # varies along axis 0
+    dy = jnp.broadcast_to(d[None, :], (2 * r + 1, 2 * r + 1))  # varies along axis 1
+    delta = jnp.stack([dx, dy], axis=-1)  # (9, 9, 2) in (x, y) order
+
+    out = []
+    for i, corr in enumerate(pyramid):
+        centroid = (coords / 2**i).reshape(b * h * w, 1, 1, 2)
+        sampled = bilinear_sample(corr, centroid + delta)  # (BHW, 9, 9, 1)
+        out.append(sampled.reshape(b, h, w, (2 * r + 1) ** 2))
+    return jnp.concatenate(out, axis=-1)  # (B, H, W, 4·81)
+
+
+def _motion_encoder(p: dict, flow: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
+    cor = _relu(conv2d(p["convc1"], corr, 1, 0))
+    cor = _relu(conv2d(p["convc2"], cor, 1, 1))
+    flo = _relu(conv2d(p["convf1"], flow, 1, 3))
+    flo = _relu(conv2d(p["convf2"], flo, 1, 1))
+    out = _relu(conv2d(p["conv"], jnp.concatenate([cor, flo], -1), 1, 1))
+    return jnp.concatenate([out, flow], -1)
+
+
+def _sep_conv_gru(p: dict, h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """Separable ConvGRU: a 1×5 pass then a 5×1 pass (update.py:37-64)."""
+    for suffix, pad in (("1", (0, 2)), ("2", (2, 0))):
+        hx = jnp.concatenate([h, x], -1)
+        z = jax.nn.sigmoid(conv2d(p[f"convz{suffix}"], hx, 1, pad))
+        r = jax.nn.sigmoid(conv2d(p[f"convr{suffix}"], hx, 1, pad))
+        q = jnp.tanh(conv2d(p[f"convq{suffix}"], jnp.concatenate([r * h, x], -1), 1, pad))
+        h = (1 - z) * h + z * q
+    return h
+
+
+def _convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """×8 convex combination of 3×3 neighbors (raft.py:100-111)."""
+    from ..ops.nnf import extract_patches_3x3
+
+    b, h, w, _ = flow.shape
+    m = mask.reshape(b, h, w, 9, 8, 8)
+    m = jax.nn.softmax(m, axis=3)
+    patches = extract_patches_3x3(8.0 * flow)  # (B, H, W, 9, 2)
+    up = jnp.einsum("bhwkij,bhwkc->bhwijc", m, patches)
+    return up.transpose(0, 1, 3, 2, 4, 5).reshape(b, 8 * h, 8 * w, 2)
+
+
+def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
+                 iters: int = ITERS) -> jnp.ndarray:
+    """Flow from frame1 to frame2. Inputs (B, H, W, 3) float RGB in [0, 255],
+    H and W divisible by 8. Returns (B, H, W, 2) flow in pixels (u, v)."""
+    x1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
+    x2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+
+    f1 = _encoder(params["fnet"], x1, "instance").astype(jnp.float32)
+    f2 = _encoder(params["fnet"], x2, "instance").astype(jnp.float32)
+    pyramid = _build_pyramid(f1, f2)
+
+    cnet = _encoder(params["cnet"], x1, "batch")
+    net = jnp.tanh(cnet[..., :HIDDEN_DIM])
+    inp = _relu(cnet[..., HIDDEN_DIM:])
+
+    b, h8, w8, _ = f1.shape
+    coords0 = coords_grid(b, h8, w8)
+    up = params["update_block"]
+
+    def body(carry, _):
+        net, coords1 = carry
+        corr = _lookup(pyramid, coords1)
+        flow = coords1 - coords0
+        motion = _motion_encoder(up["encoder"], flow, corr)
+        net = _sep_conv_gru(up["gru"], net, jnp.concatenate([inp, motion], -1))
+        delta = conv2d(up["flow_head"]["conv2"],
+                       _relu(conv2d(up["flow_head"]["conv1"], net, 1, 1)), 1, 1)
+        return (net, coords1 + delta), None
+
+    (net, coords1), _ = lax.scan(body, (net, coords0), None, length=iters)
+
+    mask = 0.25 * conv2d(up["mask.2"], _relu(conv2d(up["mask.0"], net, 1, 1)), 1, 0)
+    return _convex_upsample(coords1 - coords0, mask)
+
+
+def pad_to_multiple_of_8(frames: np.ndarray) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """Replicate-pad (…, H, W, C) to /8 sizes, sintel split (raft.py:27-39).
+
+    Returns (padded, (top, bottom, left, right)) for :func:`unpad`.
+    """
+    h, w = frames.shape[-3:-1]
+    ph = (8 - h % 8) % 8
+    pw = (8 - w % 8) % 8
+    top, bottom = ph // 2, ph - ph // 2
+    left, right = pw // 2, pw - pw // 2
+    pad = [(0, 0)] * (frames.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
+    return np.pad(frames, pad, mode="edge"), (top, bottom, left, right)
+
+
+def unpad(x: np.ndarray, pads: Tuple[int, int, int, int]) -> np.ndarray:
+    top, bottom, left, right = pads
+    h, w = x.shape[-3:-1]
+    return x[..., top : h - bottom, left : w - right, :]
+
+
+# ---------------------------------------------------------------------------
+# Shapes / random init (no torch needed): (cin, cout, kh, kw, pad-implied-by-use)
+# ---------------------------------------------------------------------------
+
+def _conv_shapes() -> Dict[str, Tuple[int, int, int, int]]:
+    shapes: Dict[str, Tuple[int, int, int, int]] = {}
+
+    def encoder(prefix: str, out_dim: int, batch_norm: bool):
+        shapes[f"{prefix}.conv1"] = (3, 64, 7, 7)
+        if batch_norm:
+            shapes[f"{prefix}.norm1"] = (64,)
+        cin = 64
+        for stage, dim, stride in (("layer1", 64, 1), ("layer2", 96, 2), ("layer3", 128, 2)):
+            for blk in (0, 1):
+                s = stride if blk == 0 else 1
+                p = f"{prefix}.{stage}.{blk}"
+                shapes[f"{p}.conv1"] = (cin if blk == 0 else dim, dim, 3, 3)
+                shapes[f"{p}.conv2"] = (dim, dim, 3, 3)
+                if batch_norm:
+                    shapes[f"{p}.norm1"] = (dim,)
+                    shapes[f"{p}.norm2"] = (dim,)
+                if blk == 0 and s != 1:
+                    shapes[f"{p}.downsample.0"] = (cin, dim, 1, 1)
+                    if batch_norm:
+                        shapes[f"{p}.norm3"] = (dim,)
+            cin = dim
+        shapes[f"{prefix}.conv2"] = (128, out_dim, 1, 1)
+
+    encoder("fnet", 256, batch_norm=False)
+    encoder("cnet", HIDDEN_DIM + CONTEXT_DIM, batch_norm=True)
+
+    cor_planes = CORR_LEVELS * (2 * CORR_RADIUS + 1) ** 2  # 324
+    ub = "update_block"
+    shapes[f"{ub}.encoder.convc1"] = (cor_planes, 256, 1, 1)
+    shapes[f"{ub}.encoder.convc2"] = (256, 192, 3, 3)
+    shapes[f"{ub}.encoder.convf1"] = (2, 128, 7, 7)
+    shapes[f"{ub}.encoder.convf2"] = (128, 64, 3, 3)
+    shapes[f"{ub}.encoder.conv"] = (192 + 64, 126, 3, 3)
+    gru_in = HIDDEN_DIM + 128 + HIDDEN_DIM  # h + (motion 128) + context
+    for sfx, k in (("1", (1, 5)), ("2", (5, 1))):
+        for gate in ("convz", "convr", "convq"):
+            shapes[f"{ub}.gru.{gate}{sfx}"] = (gru_in, HIDDEN_DIM, *k)
+    shapes[f"{ub}.flow_head.conv1"] = (HIDDEN_DIM, 256, 3, 3)
+    shapes[f"{ub}.flow_head.conv2"] = (256, 2, 3, 3)
+    shapes[f"{ub}.mask.0"] = (128, 256, 3, 3)
+    shapes[f"{ub}.mask.2"] = (256, 64 * 9, 1, 1)
+    return shapes
+
+
+def raft_init_params(seed: int = 0) -> Dict:
+    """Deterministic random param pytree with checkpoint-identical structure."""
+    rng = np.random.default_rng(seed)
+    tree: Dict = {}
+
+    def put(path, leaf):
+        node = tree
+        for p in path[:-1]:
+            node = node.setdefault(p, {})
+        node[path[-1]] = leaf
+
+    for name, shape in _conv_shapes().items():
+        path = name.split(".")
+        merged = []
+        i = 0
+        while i < len(path):
+            if i + 1 < len(path) and path[i + 1].isdigit():
+                merged.append(path[i] + "." + path[i + 1])
+                i += 2
+            else:
+                merged.append(path[i])
+                i += 1
+        if len(shape) == 1:  # batch norm
+            c = shape[0]
+            put(merged, {
+                "scale": rng.uniform(0.5, 1.5, c).astype(np.float32),
+                "bias": (rng.standard_normal(c) * 0.05).astype(np.float32),
+                "mean": (rng.standard_normal(c) * 0.05).astype(np.float32),
+                "var": rng.uniform(0.5, 1.5, c).astype(np.float32),
+            })
+        else:
+            cin, cout, kh, kw = shape
+            put(merged, {
+                "kernel": (rng.standard_normal((kh, kw, cin, cout)) * 0.05).astype(np.float32),
+                "bias": (rng.standard_normal(cout) * 0.05).astype(np.float32),
+            })
+    return tree

@@ -1,0 +1,102 @@
+"""Functional NN primitives on NHWC for the weight-tied flow nets.
+
+RAFT runs one update block 20 times (``/root/reference/models/raft/raft_src/raft.py:151-168``)
+— on TPU that is a ``lax.scan`` over a pure function of a param pytree, not a module
+graph. These helpers are the conv/norm vocabulary those pure functions are written
+in. Param leaves follow Flax conventions (``kernel`` HWIO, ``bias``; norms use
+``scale``/``bias``/``mean``/``var``) so converted checkpoints are ordinary pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+Pad = Union[str, int, Tuple[int, int], Sequence[Tuple[int, int]]]
+
+
+def conv2d(p: dict, x: jnp.ndarray, stride: int = 1, padding: Pad = 0,
+           dilation: int = 1) -> jnp.ndarray:
+    """torch ``Conv2d`` numerics on NHWC with an HWIO kernel pytree ``p``."""
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    elif isinstance(padding, tuple) and len(padding) == 2 and isinstance(padding[0], int):
+        padding = ((padding[0], padding[0]), (padding[1], padding[1]))
+    y = lax.conv_general_dilated(
+        x,
+        p["kernel"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        rhs_dilation=(dilation, dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+def conv2d_transpose(p: dict, x: jnp.ndarray, stride: int = 2, padding: int = 1,
+                     kernel_size: int = 4) -> jnp.ndarray:
+    """torch ``ConvTranspose2d(k, stride, padding)`` numerics on NHWC.
+
+    Implemented as the gradient-of-conv (what torch computes): lhs dilation by
+    ``stride`` with padding ``k − 1 − padding`` and a spatially-flipped kernel.
+    Kernel pytree stores HWIO of the *forward* conv orientation (converted from
+    torch's (in, out, kh, kw) layout).
+    """
+    k = kernel_size
+    pad = k - 1 - padding
+    y = lax.conv_general_dilated(
+        x,
+        jnp.flip(p["kernel"], (0, 1)).astype(x.dtype),
+        window_strides=(1, 1),
+        padding=((pad, pad), (pad, pad)),
+        lhs_dilation=(stride, stride),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    if "bias" in p:
+        y = y + p["bias"].astype(y.dtype)
+    return y
+
+
+def instance_norm(x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """torch ``InstanceNorm2d`` defaults: no affine, biased variance, per (n, c)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(1, 2), keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=(1, 2), keepdims=True)
+    return ((x32 - mean) / jnp.sqrt(var + eps)).astype(x.dtype)
+
+
+def batch_norm_eval(p: dict, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Eval-mode BatchNorm from stored statistics (fp32 affine, cast back)."""
+    inv = p["scale"].astype(jnp.float32) / jnp.sqrt(p["var"].astype(jnp.float32) + eps)
+    return ((x.astype(jnp.float32) - p["mean"]) * inv + p["bias"]).astype(x.dtype)
+
+
+def avg_pool2d(x: jnp.ndarray, window: int = 2, stride: Optional[int] = None) -> jnp.ndarray:
+    """torch ``F.avg_pool2d`` (VALID, count includes full window) on NHWC."""
+    stride = stride or window
+    summed = lax.reduce_window(
+        x.astype(jnp.float32), 0.0, lax.add,
+        (1, window, window, 1), (1, stride, stride, 1), "VALID",
+    )
+    return (summed / (window * window)).astype(x.dtype)
+
+
+def leaky_relu(x: jnp.ndarray, negative_slope: float = 0.1) -> jnp.ndarray:
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def extract_patches_3x3(x: jnp.ndarray) -> jnp.ndarray:
+    """3×3 zero-padded neighborhoods: (N, H, W, C) → (N, H, W, 9, C), window
+    row-major (dy, dx) — torch ``F.unfold(x, [3,3], padding=1)`` tap order."""
+    n, h, w, c = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    taps = [
+        xp[:, dy : dy + h, dx : dx + w, :]
+        for dy in range(3)
+        for dx in range(3)
+    ]
+    return jnp.stack(taps, axis=3)

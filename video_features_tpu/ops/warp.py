@@ -1,0 +1,119 @@
+"""Bilinear gather ops replacing torch ``grid_sample`` on TPU.
+
+Both flow nets sample feature maps at fractional pixel coordinates: RAFT's
+correlation lookup (``/root/reference/models/raft/raft_src/utils/utils.py:57-71``,
+``align_corners=True`` + zero padding) and PWC's backward warp
+(``/root/reference/models/pwc/pwc_src/pwc_net.py:23-41``; under the pinned
+torch 1.2 ``grid_sample`` also behaves as align_corners=True). Working in *pixel*
+coordinates directly — the normalize/denormalize round-trip of grid_sample with
+align_corners=True is the identity — keeps the math exact and avoids the (W−1)/2
+rescaling noise.
+
+XLA lowers the gathers to dynamic-slice-friendly ops; all shapes static.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bilinear_sample(img: jnp.ndarray, coords_xy: jnp.ndarray) -> jnp.ndarray:
+    """Sample ``img`` (N, H, W, C) at pixel coords (N, P, Q, 2) (x, y) order.
+
+    Zero padding: out-of-bounds corner taps contribute 0 — per-corner masking,
+    matching ``grid_sample(..., padding_mode='zeros', align_corners=True)``.
+    Returns (N, P, Q, C) float32.
+    """
+    n, h, w, c = img.shape
+    x = coords_xy[..., 0].astype(jnp.float32)
+    y = coords_xy[..., 1].astype(jnp.float32)
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    out = None
+    flat = img.reshape(n, h * w, c).astype(jnp.float32)
+    for dy, dx, wgt in (
+        (0, 0, (1 - wy) * (1 - wx)),
+        (0, 1, (1 - wy) * wx),
+        (1, 0, wy * (1 - wx)),
+        (1, 1, wy * wx),
+    ):
+        xi = x0 + dx
+        yi = y0 + dy
+        inb = (xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1)
+        xg = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        yg = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        idx = (yg * w + xg).reshape(n, -1)
+        vals = jnp.take_along_axis(flat, idx[..., None], axis=1).reshape(*x.shape, c)
+        contrib = vals * (wgt * inb.astype(jnp.float32))[..., None]
+        out = contrib if out is None else out + contrib
+    return out
+
+
+def warp_backward(img: jnp.ndarray, flow: jnp.ndarray) -> jnp.ndarray:
+    """PWC backward warp: sample ``img`` at ``base + flow``, zeroing partial taps.
+
+    Reference semantics (``pwc_net.py:23-41``): a ones channel rides along; where its
+    sampled value is ≤ 0.999 (any out-of-bounds leakage) the whole output pixel is
+    zeroed, otherwise scaled by exactly 1.0.
+
+    ``img`` (N, H, W, C); ``flow`` (N, H, W, 2) in pixels (u, v). Returns (N, H, W, C).
+    """
+    n, h, w, _ = flow.shape
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    base = jnp.stack([xs, ys], axis=-1)[None]
+    coords = base + flow
+    ones = jnp.ones(img.shape[:-1] + (1,), jnp.float32)
+    sampled = bilinear_sample(jnp.concatenate([img.astype(jnp.float32), ones], -1), coords)
+    out, mask = sampled[..., :-1], sampled[..., -1:]
+    keep = (mask > 0.999).astype(jnp.float32)
+    return out * keep
+
+
+def coords_grid(n: int, h: int, w: int) -> jnp.ndarray:
+    """(N, H, W, 2) grid of (x, y) pixel coordinates (RAFT ``coords_grid``)."""
+    ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
+                          jnp.arange(w, dtype=jnp.float32), indexing="ij")
+    return jnp.broadcast_to(jnp.stack([xs, ys], axis=-1), (n, h, w, 2))
+
+
+def upsample_bilinear_align(img: jnp.ndarray, out_h: int, out_w: int) -> jnp.ndarray:
+    """Bilinear resize with align_corners=True on (N, H, W, C).
+
+    torch ``F.interpolate(..., mode='bilinear', align_corners=True)``: output pixel i
+    maps to input coordinate i·(H−1)/(out−1). In-bounds by construction, so the
+    zero-padding masks in :func:`bilinear_sample` never fire.
+    """
+    n, h, w, _ = img.shape
+    sy = (h - 1) / (out_h - 1) if out_h > 1 else 0.0
+    sx = (w - 1) / (out_w - 1) if out_w > 1 else 0.0
+    ys = jnp.arange(out_h, dtype=jnp.float32) * sy
+    xs = jnp.arange(out_w, dtype=jnp.float32) * sx
+    gx, gy = jnp.meshgrid(xs, ys)
+    coords = jnp.broadcast_to(jnp.stack([gx, gy], -1), (n, out_h, out_w, 2))
+    return bilinear_sample(img, coords)
+
+
+def resize_bilinear_torch(img: jnp.ndarray, out_h: int, out_w: int) -> jnp.ndarray:
+    """Bilinear resize with align_corners=False (torch default), NHWC.
+
+    Source coordinate: (i + 0.5)·scale − 0.5, clamped taps at the border (replicate
+    edge — torch clamps the corner indices, it does not zero them).
+    """
+    n, h, w, c = img.shape
+    sy = h / out_h
+    sx = w / out_w
+    ys = jnp.clip((jnp.arange(out_h, dtype=jnp.float32) + 0.5) * sy - 0.5, 0.0, None)
+    xs = jnp.clip((jnp.arange(out_w, dtype=jnp.float32) + 0.5) * sx - 0.5, 0.0, None)
+    # clamping low keeps coords ≥ 0; high side handled by corner clipping because
+    # weights for the out-of-range corner go to the in-range one only when the
+    # coordinate itself is in range — clamp high too for exactness
+    ys = jnp.minimum(ys, h - 1)
+    xs = jnp.minimum(xs, w - 1)
+    gx, gy = jnp.meshgrid(xs, ys)
+    coords = jnp.broadcast_to(jnp.stack([gx, gy], -1), (n, out_h, out_w, 2))
+    return bilinear_sample(img, coords)

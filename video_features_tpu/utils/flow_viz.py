@@ -1,0 +1,78 @@
+"""Middlebury color-wheel optical-flow visualization (numpy).
+
+Behavioral spec: ``/root/reference/models/raft/raft_src/utils/flow_viz.py`` (duplicated
+byte-identically under pwc_src — SURVEY.md §2.1 #20): a 55-entry RY/YG/GC/CB/BM/MR
+color wheel, flow angle selects the hue by linear interpolation, radius saturates
+toward the wheel color, out-of-range radii darken by 0.75. Used by ``--show_pred`` for
+raft/pwc and available as a public util.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SEGMENTS = (  # (count, base channel pattern) — RY, YG, GC, CB, BM, MR
+    (15, (255, "up", 0)),
+    (6, ("down", 255, 0)),
+    (4, (0, 255, "up")),
+    (11, (0, "down", 255)),
+    (13, ("up", 0, 255)),
+    (6, (255, 0, "down")),
+)
+
+
+def make_colorwheel() -> np.ndarray:
+    """(55, 3) uint-valued float RGB color wheel."""
+    total = sum(n for n, _ in _SEGMENTS)
+    wheel = np.zeros((total, 3), np.float64)
+    row = 0
+    for count, pattern in _SEGMENTS:
+        ramp = np.floor(255 * np.arange(count) / count)
+        for ch, spec in enumerate(pattern):
+            if spec == "up":
+                wheel[row : row + count, ch] = ramp
+            elif spec == "down":
+                wheel[row : row + count, ch] = 255 - ramp
+            else:
+                wheel[row : row + count, ch] = spec
+        row += count
+    return wheel
+
+
+def flow_to_image(flow_uv: np.ndarray, clip_flow: float | None = None,
+                  convert_to_bgr: bool = False) -> np.ndarray:
+    """(H, W, 2) flow → (H, W, 3) uint8 color image.
+
+    Flow is normalized by its maximum radius (plus epsilon) before coloring, as the
+    reference does, so the visualization is per-frame relative.
+    """
+    assert flow_uv.ndim == 3 and flow_uv.shape[2] == 2, flow_uv.shape
+    if clip_flow is not None:
+        flow_uv = np.clip(flow_uv, 0, clip_flow)
+    u = flow_uv[:, :, 0].astype(np.float64)
+    v = flow_uv[:, :, 1].astype(np.float64)
+    rad = np.sqrt(u * u + v * v)
+    rad_max = rad.max() if rad.size else 0.0
+    eps = 1e-5
+    u = u / (rad_max + eps)
+    v = v / (rad_max + eps)
+
+    wheel = make_colorwheel()
+    ncols = wheel.shape[0]
+    rad = np.sqrt(u * u + v * v)
+    a = np.arctan2(-v, -u) / np.pi  # [-1, 1]
+    fk = (a + 1) / 2 * (ncols - 1)  # map to wheel index space
+    k0 = np.floor(fk).astype(np.int32)
+    k1 = (k0 + 1) % ncols
+    f = fk - k0
+
+    img = np.zeros((*u.shape, 3), np.uint8)
+    for ch in range(3):
+        col0 = wheel[k0, ch] / 255.0
+        col1 = wheel[k1, ch] / 255.0
+        col = (1 - f) * col0 + f * col1
+        small = rad <= 1
+        col[small] = 1 - rad[small] * (1 - col[small])  # saturate toward white center
+        col[~small] = col[~small] * 0.75  # out of range: darken
+        img[:, :, 2 - ch if convert_to_bgr else ch] = np.floor(255 * col)
+    return img

@@ -83,6 +83,79 @@ def convert_resnet50(state_dict: Mapping) -> dict:
     return params
 
 
+def _merge_numeric_tokens(key: str) -> Tuple[str, ...]:
+    """Split a torch key on '.', re-joining ``name.<digit>`` pairs into one path
+    element (torch flattens Sequential/list indices; the pytrees keep them)."""
+    tokens = key.split(".")
+    merged = []
+    i = 0
+    while i < len(tokens):
+        if i + 1 < len(tokens) and tokens[i + 1].isdigit():
+            merged.append(tokens[i] + "." + tokens[i + 1])
+            i += 2
+        else:
+            merged.append(tokens[i])
+            i += 1
+    return tuple(merged)
+
+
+def convert_raft(state_dict: Mapping) -> dict:
+    """Reference RAFT checkpoint (``raft-sintel.pth`` et al., keys prefixed
+    ``module.`` by the vestigial DataParallel wrap — ``extract_raft.py:58-59``) →
+    the param pytree of :func:`video_features_tpu.models.raft.raft_forward`.
+
+    Instance norms carry no params; cnet batch norms map to scale/bias/mean/var.
+    ``downsample.1`` keys alias ``norm3`` (the module is registered under both
+    names) and fold onto the ``norm3`` path.
+    """
+    sd = to_numpy_state_dict(state_dict)
+    params: dict = {}
+    for key, value in sd.items():
+        if key.startswith("module."):
+            key = key[len("module."):]
+        if key.endswith("num_batches_tracked"):
+            continue
+        *path, leaf = _merge_numeric_tokens(key)
+        if path and path[-1] == "downsample.1":
+            path[-1] = "norm3"
+        if leaf == "weight" and value.ndim == 4:
+            set_path(params, (*path, "kernel"), conv2d_kernel(value))
+        elif leaf in _BN_MAP and value.ndim == 1 and (
+            path and ("norm" in path[-1])
+        ):
+            set_path(params, (*path, _BN_MAP[leaf]), value)
+        elif leaf == "bias":
+            set_path(params, (*path, "bias"), value)
+        else:
+            raise ValueError(f"unrecognized RAFT checkpoint key: {key}")
+    return params
+
+
+def convert_pwc(state_dict: Mapping) -> dict:
+    """Reference PWC checkpoint (``pwc_net_sintel.pt``,
+    ``/root/reference/models/pwc/pwc_src/pwc_net.py`` naming) → the param pytree of
+    :func:`video_features_tpu.models.pwc.pwc_forward`.
+
+    ``moduleUpflow``/``moduleUpfeat`` are ConvTranspose2d with torch layout
+    (in, out, kh, kw); everything else is a regular conv (out, in, kh, kw).
+    """
+    sd = to_numpy_state_dict(state_dict)
+    params: dict = {}
+    for key, value in sd.items():
+        if key.startswith("module."):
+            key = key[len("module."):]
+        *path, leaf = key.split(".")
+        if leaf == "weight":
+            transpose_conv = path[-1] in ("moduleUpflow", "moduleUpfeat")
+            kernel = np.transpose(value, (2, 3, 0, 1) if transpose_conv else (2, 3, 1, 0))
+            set_path(params, (*path, "kernel"), kernel)
+        elif leaf == "bias":
+            set_path(params, (*path, "bias"), value)
+        else:
+            raise ValueError(f"unrecognized PWC checkpoint key: {key}")
+    return params
+
+
 def convert_i3d(state_dict: Mapping) -> dict:
     """Reference I3D checkpoint (``i3d_rgb.pt``/``i3d_flow.pt`` state_dict naming,
     ``/root/reference/models/i3d/i3d_src/i3d_net.py``) → :class:`models.i3d.I3D`
@@ -97,17 +170,7 @@ def convert_i3d(state_dict: Mapping) -> dict:
     for key, value in sd.items():
         if key.endswith("num_batches_tracked"):
             continue
-        tokens = key.split(".")
-        merged = []
-        i = 0
-        while i < len(tokens):
-            if tokens[i].startswith("branch_") and i + 1 < len(tokens) and tokens[i + 1].isdigit():
-                merged.append(tokens[i] + "." + tokens[i + 1])
-                i += 2
-            else:
-                merged.append(tokens[i])
-                i += 1
-        *path, module, leaf = merged
+        *path, module, leaf = _merge_numeric_tokens(key)
         if module == "conv3d":
             if leaf == "weight":
                 set_path(params, (*path, "conv3d", "kernel"), conv3d_kernel(value))
