@@ -430,6 +430,67 @@ def pwc_torch_forward(sd, image1, image2):
         return flow
 
 
+# ---------------------------------------------------------------------------
+# R(2+1)D-18: functional torch mirror (torchvision r2plus1d_18 numerics), driven
+# by the SAME shape spec as the Flax model.
+# ---------------------------------------------------------------------------
+
+from video_features_tpu.models.r21d import STAGE_CHANNELS as R21D_STAGES
+from video_features_tpu.models.r21d import r21d_conv_shapes
+
+
+def r21d_random_state_dict(seed: int = 0):
+    g = torch.Generator().manual_seed(seed)
+    sd = {}
+    for name, shape in r21d_conv_shapes().items():
+        if shape[0] == "bn":
+            c = shape[1]
+            sd[f"{name}.weight"] = torch.rand(c, generator=g) + 0.5
+            sd[f"{name}.bias"] = torch.randn(c, generator=g) * 0.05
+            sd[f"{name}.running_mean"] = torch.randn(c, generator=g) * 0.05
+            sd[f"{name}.running_var"] = torch.rand(c, generator=g) + 0.5
+        elif name == "fc":
+            sd["fc.weight"] = torch.randn(shape, generator=g) * 0.05
+            sd["fc.bias"] = torch.randn(shape[0], generator=g) * 0.05
+        else:
+            sd[f"{name}.weight"] = torch.randn(shape, generator=g) * 0.05
+    return sd
+
+
+def _r21d_bn(sd, name, x):
+    return F.batch_norm(x, sd[f"{name}.running_mean"], sd[f"{name}.running_var"],
+                        sd[f"{name}.weight"], sd[f"{name}.bias"], training=False)
+
+
+def _r21d_2plus1(sd, prefix, x, stride=1):
+    x = F.conv3d(x, sd[f"{prefix}.0.weight"], None, (1, stride, stride), (0, 1, 1))
+    x = F.relu(_r21d_bn(sd, f"{prefix}.1", x))
+    return F.conv3d(x, sd[f"{prefix}.3.weight"], None, (stride, 1, 1), (1, 0, 0))
+
+
+def r21d_forward(sd, x, features=True):
+    """(B, 3, T, H, W) normalized float → (B, 512) features or (B, 400) logits."""
+    with torch.no_grad():
+        x = F.conv3d(x, sd["stem.0.weight"], None, (1, 2, 2), (0, 3, 3))
+        x = F.relu(_r21d_bn(sd, "stem.1", x))
+        x = F.conv3d(x, sd["stem.3.weight"], None, 1, (1, 0, 0))
+        x = F.relu(_r21d_bn(sd, "stem.4", x))
+        for stage in range(1, 5):
+            for blk in range(2):
+                p = f"layer{stage}.{blk}"
+                stride = 2 if (stage > 1 and blk == 0) else 1
+                y = F.relu(_r21d_bn(sd, f"{p}.conv1.1", _r21d_2plus1(sd, f"{p}.conv1.0", x, stride)))
+                y = _r21d_bn(sd, f"{p}.conv2.1", _r21d_2plus1(sd, f"{p}.conv2.0", y))
+                if f"{p}.downsample.0.weight" in sd:
+                    x = _r21d_bn(sd, f"{p}.downsample.1",
+                                 F.conv3d(x, sd[f"{p}.downsample.0.weight"], None, (stride,) * 3))
+                x = F.relu(x + y)
+        x = x.mean((2, 3, 4))
+        if features:
+            return x
+        return F.linear(x, sd["fc.weight"], sd["fc.bias"])
+
+
 def random_init_(model: nn.Module, seed: int = 0) -> nn.Module:
     """Randomize all parameters and BN running stats so parity tests are non-trivial."""
     g = torch.Generator().manual_seed(seed)

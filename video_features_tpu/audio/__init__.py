@@ -1,0 +1,3 @@
+from .melspec import log_mel_spectrogram, waveform_to_examples, wav_to_examples
+
+__all__ = ["log_mel_spectrogram", "waveform_to_examples", "wav_to_examples"]

@@ -1,0 +1,130 @@
+"""VGGish log-mel frontend: waveform → (N, 96, 64) example patches (host numpy).
+
+Behavioral spec — ``/root/reference/models/vggish/vggish_src/``:
+- constants (``vggish_params.py:21-35``): 16 kHz, 25 ms periodic-Hann window,
+  10 ms hop, 64 HTK-mel bins over 125–7500 Hz, log offset 0.01, 0.96 s example
+  windows with no overlap;
+- strided no-pad framing (``mel_features.py:21-45``), periodic Hann
+  (``:48-68``), |rfft| with fft_length = 2^ceil(log2(400)) = 512 (``:71-92``,
+  ``:214``), HTK mel weight matrix with zeroed DC bin (``:114-189``),
+  log(mel + 0.01) (``:192-223``);
+- example framing into non-overlapping (96, 64) patches (``vggish_input.py:27-65``);
+- wav read: int16 → /32768.0, stereo averaged to mono, resampled to 16 kHz
+  (``vggish_input.py:68-87``; resampy there, polyphase scipy here — the ffmpeg
+  extraction path already emits the right rate, so resampling is the rare case).
+
+This stays host-side numpy: the DSP is microseconds per clip next to the VGG
+forward, and numpy keeps it bit-comparable with the reference's own numpy frontend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+SAMPLE_RATE = 16000
+STFT_WINDOW_SECS = 0.025
+STFT_HOP_SECS = 0.010
+NUM_MEL_BINS = 64
+MEL_MIN_HZ = 125.0
+MEL_MAX_HZ = 7500.0
+LOG_OFFSET = 0.01
+EXAMPLE_WINDOW_SECS = 0.96
+EXAMPLE_HOP_SECS = 0.96
+
+_MEL_BREAK_FREQUENCY_HERTZ = 700.0
+_MEL_HIGH_FREQUENCY_Q = 1127.0
+
+
+def frame(data: np.ndarray, window_length: int, hop_length: int) -> np.ndarray:
+    """Strided framing, incomplete tail dropped (mel_features.py:21-45)."""
+    num_samples = data.shape[0]
+    num_frames = 1 + int(np.floor((num_samples - window_length) / hop_length))
+    if num_frames <= 0:
+        return np.zeros((0, window_length) + data.shape[1:], data.dtype)
+    shape = (num_frames, window_length) + data.shape[1:]
+    strides = (data.strides[0] * hop_length,) + data.strides
+    return np.lib.stride_tricks.as_strided(data, shape=shape, strides=strides)
+
+
+def periodic_hann(window_length: int) -> np.ndarray:
+    """Full-cycle raised cosine (not numpy's symmetric hanning)."""
+    return 0.5 - 0.5 * np.cos(2 * np.pi / window_length * np.arange(window_length))
+
+
+def stft_magnitude(signal: np.ndarray, fft_length: int, hop_length: int,
+                   window_length: int) -> np.ndarray:
+    frames = frame(signal, window_length, hop_length)
+    return np.abs(np.fft.rfft(frames * periodic_hann(window_length), int(fft_length)))
+
+
+def hertz_to_mel(frequencies_hertz):
+    return _MEL_HIGH_FREQUENCY_Q * np.log(
+        1.0 + np.asarray(frequencies_hertz, np.float64) / _MEL_BREAK_FREQUENCY_HERTZ
+    )
+
+
+def spectrogram_to_mel_matrix(num_mel_bins: int, num_spectrogram_bins: int,
+                              audio_sample_rate: float, lower_edge_hertz: float,
+                              upper_edge_hertz: float) -> np.ndarray:
+    """(num_spectrogram_bins, num_mel_bins) triangular HTK weights, linear in mel,
+    DC bin zeroed (mel_features.py:114-189)."""
+    nyquist = audio_sample_rate / 2.0
+    if not 0.0 <= lower_edge_hertz < upper_edge_hertz <= nyquist:
+        raise ValueError(
+            f"bad mel edges: 0 <= {lower_edge_hertz} < {upper_edge_hertz} <= {nyquist}"
+        )
+    bins_mel = hertz_to_mel(np.linspace(0.0, nyquist, num_spectrogram_bins))
+    edges_mel = np.linspace(hertz_to_mel(lower_edge_hertz),
+                            hertz_to_mel(upper_edge_hertz), num_mel_bins + 2)
+    lower = edges_mel[:-2][None, :]
+    center = edges_mel[1:-1][None, :]
+    upper = edges_mel[2:][None, :]
+    lower_slope = (bins_mel[:, None] - lower) / (center - lower)
+    upper_slope = (upper - bins_mel[:, None]) / (upper - center)
+    weights = np.maximum(0.0, np.minimum(lower_slope, upper_slope))
+    weights[0, :] = 0.0
+    return weights
+
+
+def log_mel_spectrogram(data: np.ndarray, audio_sample_rate: float = SAMPLE_RATE,
+                        log_offset: float = LOG_OFFSET,
+                        window_length_secs: float = STFT_WINDOW_SECS,
+                        hop_length_secs: float = STFT_HOP_SECS,
+                        num_mel_bins: int = NUM_MEL_BINS,
+                        lower_edge_hertz: float = MEL_MIN_HZ,
+                        upper_edge_hertz: float = MEL_MAX_HZ) -> np.ndarray:
+    window_length = int(round(audio_sample_rate * window_length_secs))
+    hop_length = int(round(audio_sample_rate * hop_length_secs))
+    fft_length = 2 ** int(np.ceil(np.log(window_length) / np.log(2.0)))
+    spectrogram = stft_magnitude(data, fft_length, hop_length, window_length)
+    mel = spectrogram @ spectrogram_to_mel_matrix(
+        num_mel_bins, spectrogram.shape[1], audio_sample_rate,
+        lower_edge_hertz, upper_edge_hertz)
+    return np.log(mel + log_offset)
+
+
+def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
+    """[-1,1] waveform (mono or channels-last stereo) → (N, 96, 64) float32."""
+    if data.ndim > 1:
+        data = np.mean(data, axis=1)
+    if sample_rate != SAMPLE_RATE:
+        from scipy.signal import resample_poly
+        from fractions import Fraction
+
+        ratio = Fraction(SAMPLE_RATE, int(round(sample_rate))).limit_denominator(1000)
+        data = resample_poly(data, ratio.numerator, ratio.denominator)
+    log_mel = log_mel_spectrogram(data)
+    features_rate = 1.0 / STFT_HOP_SECS
+    window = int(round(EXAMPLE_WINDOW_SECS * features_rate))
+    hop = int(round(EXAMPLE_HOP_SECS * features_rate))
+    return frame(log_mel, window, hop).astype(np.float32)
+
+
+def wav_to_examples(wav_path: str) -> np.ndarray:
+    """16-bit PCM wav → examples (vggish_input.py:74-87 semantics via scipy)."""
+    from scipy.io import wavfile
+
+    sr, data = wavfile.read(wav_path)
+    if data.dtype != np.int16:
+        raise ValueError(f"{wav_path}: expected 16-bit PCM, got {data.dtype}")
+    return waveform_to_examples(data / 32768.0, sr)

@@ -1,0 +1,177 @@
+"""I3D two-stream extractor: sliding 64-frame stacks → rgb & flow 1024-d features.
+
+Behavioral spec — ``/root/reference/models/i3d/extract_i3d.py``:
+- decode → PIL smaller-edge resize to 256 (``:25,54-59``);
+- accumulate ``stack_size + 1`` frames; on a full stack run both streams, keep
+  ``stack[step_size:]`` as overlap, timestamp the completed stack (``:207-215``);
+  partial trailing stacks are dropped (``:216-219``);
+- rgb stream: first 64 frames → center-crop 224 → [−1,1] (``:59-63,148-156``);
+- flow stream: flow between consecutive frames of the *256-edge* stack — RAFT on
+  replicate-padded /8 frames with NO unpadding (the 224 center crop runs on the
+  padded flow: reference quirk, ``:146-148`` + ``transforms``), PWC at native 256
+  size — then center-crop 224 → clamp ±20 → uint8 quantize → [−1,1] (``:64-72``);
+- each stream through its own pretrained I3D → (1, 1024) per stack (``:161-164``);
+- ``--show_pred``: Kinetics-400 top-5 per stack per stream (``:166-169``);
+- outputs keyed by stream name (``rgb``/``flow``) + fps + timestamps.
+
+TPU design: the ENTIRE stack step — flow net, transform sandwich, I3D — is one
+jitted program per stream set, so flow maps never leave HBM between the flow net
+and the I3D conv stack.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.video import open_video
+from ..models.i3d import I3D, i3d_preprocess_flow, i3d_preprocess_rgb
+from ..models.pwc import pwc_forward, pwc_init_params
+from ..models.raft import raft_forward, raft_init_params
+from ..ops.image import pil_edge_resize
+from ..utils.labels import show_predictions_on_dataset
+from ..weights.convert_torch import convert_i3d, convert_pwc, convert_raft
+from ..weights.store import resolve_params
+from .base import Extractor
+
+PRE_CROP_SIZE = 256
+CROP_SIZE = 224
+
+
+def _center_crop_nhwc(x: jnp.ndarray, size: int) -> jnp.ndarray:
+    """Reference TensorCenterCrop: floor-divide offsets (transforms.py:7-18)."""
+    h, w = x.shape[-3], x.shape[-2]
+    fh = (h - size) // 2
+    fw = (w - size) // 2
+    return x[..., fh : fh + size, fw : fw + size, :]
+
+
+class ExtractI3D(Extractor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        cfg = self.cfg  # model defaults resolved by the base class
+        self.streams = tuple(cfg.streams or ("rgb", "flow"))
+        self.stack_size = cfg.stack_size
+        self.step_size = cfg.step_size
+        self.flow_type = cfg.flow_type
+
+        self.i3d = {s: I3D(modality=s) for s in self.streams}
+        self.i3d_params = {
+            s: resolve_params(
+                f"i3d_{s}",
+                convert_torch_fn=convert_i3d,
+                init_fn=functools.partial(self._random_i3d, s),
+            )
+            for s in self.streams
+        }
+        if "flow" in self.streams:
+            if self.flow_type == "raft":
+                self.flow_params = resolve_params(
+                    "raft-sintel", convert_torch_fn=convert_raft,
+                    init_fn=lambda: raft_init_params(seed=0))
+            elif self.flow_type == "pwc":
+                self.flow_params = resolve_params(
+                    "pwc-sintel", convert_torch_fn=convert_pwc,
+                    init_fn=lambda: pwc_init_params(seed=0))
+            else:
+                raise ValueError(f"unknown flow_type {self.flow_type!r}")
+        else:
+            self.flow_params = None
+
+    def _random_i3d(self, stream: str):
+        model = self.i3d[stream]
+        c = 3 if stream == "rgb" else 2
+        dummy = jnp.zeros((1, 16, CROP_SIZE, CROP_SIZE, c))
+        return model.init(jax.random.PRNGKey(0), dummy, features=False)["params"]
+
+    # --- jitted stack steps -------------------------------------------------
+
+    @functools.cached_property
+    def _rgb_step(self):
+        model = self.i3d["rgb"]
+        with_pred = self.cfg.show_pred
+
+        @jax.jit
+        def step(params, stack_u8):  # (S+1, H, W, 3) uint8
+            x = i3d_preprocess_rgb(_center_crop_nhwc(stack_u8[:-1], CROP_SIZE))
+            x = x[None]  # (1, S, 224, 224, 3)
+            feats = model.apply({"params": params}, x, features=True)
+            if with_pred:
+                _, logits = model.apply({"params": params}, x, features=False)
+                return feats, logits
+            return feats, None
+
+        return step
+
+    @functools.cached_property
+    def _flow_step(self):
+        model = self.i3d["flow"]
+        flow_type = self.flow_type
+        flow_params = self.flow_params
+        with_pred = self.cfg.show_pred
+
+        @jax.jit
+        def step(params, stack_u8):  # (S+1, H, W, 3) uint8
+            frames = stack_u8.astype(jnp.float32)
+            if flow_type == "raft":
+                # replicate-pad to /8 and, like the reference, never unpad: the
+                # 224 center crop below runs on the padded flow
+                h, w = frames.shape[1:3]
+                ph, pw = (8 - h % 8) % 8, (8 - w % 8) % 8
+                pads = ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
+                padded = jnp.pad(frames, pads, mode="edge")
+                flow = raft_forward(flow_params, padded[:-1], padded[1:])
+            else:
+                flow = pwc_forward(flow_params, frames[:-1], frames[1:])
+            x = i3d_preprocess_flow(_center_crop_nhwc(flow, CROP_SIZE))
+            x = x[None]  # (1, S, 224, 224, 2)
+            feats = model.apply({"params": params}, x, features=True)
+            if with_pred:
+                _, logits = model.apply({"params": params}, x, features=False)
+                return feats, logits
+            return feats, None
+
+        return step
+
+    # --- pipeline -----------------------------------------------------------
+
+    def _run_stack(self, feats_dict, stack: List[np.ndarray], video_path, stack_counter):
+        stack_u8 = jnp.asarray(np.stack(stack))
+        for stream in self.streams:
+            step = self._rgb_step if stream == "rgb" else self._flow_step
+            feats, logits = step(self.i3d_params[stream], stack_u8)
+            feats_dict[stream].extend(np.asarray(feats))
+            if logits is not None:
+                print(f"{video_path} @ stack {stack_counter} ({stream} stream)")
+                show_predictions_on_dataset(np.asarray(logits), "kinetics")
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        meta, frames_iter = open_video(
+            video_path,
+            extraction_fps=self.cfg.extraction_fps,
+            tmp_path=self.tmp_dir,
+            keep_tmp_files=self.cfg.keep_tmp_files,
+            transform=lambda rgb: pil_edge_resize(rgb, PRE_CROP_SIZE),
+        )
+        feats_dict: Dict[str, list] = {s: [] for s in self.streams}
+        timestamps_ms: List[float] = []
+        stack: List[np.ndarray] = []
+        stack_counter = 0
+        for rgb, pos in frames_iter:
+            stack.append(rgb)
+            if len(stack) - 1 == self.stack_size:
+                self._run_stack(feats_dict, stack, video_path, stack_counter)
+                stack = stack[self.step_size :]
+                stack_counter += 1
+                timestamps_ms.append(pos)
+        # trailing partial stack dropped, as in the reference (:216-219)
+
+        out = {s: np.asarray(v, np.float32) for s, v in feats_dict.items()}
+        out["fps"] = np.array(meta.fps)
+        out["timestamps_ms"] = np.array(timestamps_ms)
+        return out

@@ -1,0 +1,101 @@
+"""R(2+1)D-18 clip extractor: whole-video decode → 16-frame slices → 512-d features.
+
+Behavioral spec — ``/root/reference/models/r21d/extract_r21d.py``:
+- whole video into RAM (``read_video``, ``:102``); fps re-encode forbidden by the
+  reference ``sanity_check`` (enforced in :mod:`video_features_tpu.config`);
+- transforms: /255 → bilinear resize (128, 171) → Kinetics normalize → center crop
+  112 (``:32-38``);
+- ``form_slices`` full 16-frame windows, step 16, trailing frames dropped (``:107``);
+- per-slice r2plus1d_18 with identity head → 512-d; ``--show_pred`` applies the
+  saved fc for Kinetics top-5 (``:111-121``);
+- output: features only — the reference omits fps/timestamps for this model
+  (``:123-125``), reproduced for drop-in parity.
+
+TPU design: slices are batched ``clips_per_batch`` at a time into one jitted step
+(static shapes, tail zero-padded then trimmed); preprocess runs on device fused
+into the stem.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.video import decode_all
+from ..models.r21d import NUM_FEATURES, R2Plus1D18, r21d_preprocess
+from ..utils.labels import show_predictions_on_dataset
+from ..utils.windows import form_slices
+from ..weights.convert_torch import convert_r21d
+from ..weights.store import resolve_params
+from .base import Extractor, pad_batch
+
+
+class ExtractR21D(Extractor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        cfg = self.cfg  # model defaults resolved by the base class
+        self.stack_size = cfg.stack_size
+        self.step_size = cfg.step_size
+        self.clips_per_batch = cfg.clips_per_batch
+        self.model = R2Plus1D18()
+        self.params = resolve_params(
+            "r2plus1d_18",
+            convert_torch_fn=convert_r21d,
+            init_fn=self._random_init,
+        )
+        if cfg.show_pred and "fc" not in self.params:
+            raise ValueError(
+                "--show_pred needs the classifier head, but the resolved r2plus1d_18 "
+                "checkpoint has no 'fc' params"
+            )
+
+    def _random_init(self):
+        dummy = jnp.zeros((1, 4, 112, 112, 3))
+        return self.model.init(jax.random.PRNGKey(0), dummy, features=False)["params"]
+
+    @functools.cached_property
+    def _step(self):
+        model = self.model
+
+        @jax.jit
+        def step(params, clips_u8):  # (N, 16, H, W, 3) uint8 native resolution
+            n, t = clips_u8.shape[:2]
+            flat = clips_u8.reshape((n * t,) + clips_u8.shape[2:])
+            x = r21d_preprocess(flat).reshape((n, t, 112, 112, 3))
+            return model.apply({"params": params}, x, features=True).astype(jnp.float32)
+
+        return step
+
+    def extract(self, video_path: str) -> Dict[str, np.ndarray]:
+        meta, frames, _ts = decode_all(
+            video_path,
+            extraction_fps=None,  # validated off for r21d
+            tmp_path=self.tmp_dir,
+        )
+        slices = form_slices(frames.shape[0], self.stack_size, self.step_size)
+        vid_feats = []
+        for i in range(0, len(slices), self.clips_per_batch):
+            chunk = slices[i : i + self.clips_per_batch]
+            clips = np.stack([frames[s:e] for s, e in chunk])
+            clips = pad_batch(clips, self.clips_per_batch)
+            feats = np.asarray(self._step(self.params, clips))[: len(chunk)]
+            vid_feats.append(feats)
+            if self.cfg.show_pred:
+                fc = self.params["fc"]
+                logits = feats @ np.asarray(fc["kernel"]) + np.asarray(fc["bias"])
+                for (s, e), row in zip(chunk, logits):
+                    print(f"{video_path} @ frames ({s}, {e})")
+                    show_predictions_on_dataset(row[None], "kinetics")
+
+        feats = (
+            np.concatenate(vid_feats, axis=0)
+            if vid_feats
+            else np.zeros((0, NUM_FEATURES), np.float32)
+        )
+        # reference returns features only for r21d (extract_r21d.py:123-125)
+        return {self.feature_type: feats}

@@ -135,6 +135,11 @@ class I3D(nn.Module):
         # The reference kernel (2,7,7) assumes the 224-crop geometry where the final
         # spatial size is exactly 7×7; the spatial kernel adapts so smaller (test)
         # inputs work — identical numerics at the supported 224 input.
+        if x.shape[1] < 2:
+            raise ValueError(
+                f"input too short for I3D: {x.shape[1]} temporal positions remain "
+                f"before the (2,·,·) average pool; use stack_size >= 16"
+            )
         x = avg_pool_valid(x.astype(jnp.float32), (2, x.shape[2], x.shape[3]), (1, 1, 1))
         if features:
             return jnp.mean(x[:, :, 0, 0, :], axis=1)  # (B, 1024)

@@ -1,0 +1,130 @@
+"""VGGish audio embedding network in Flax (NHWC) + PCA postprocessor.
+
+Behavioral spec — ``/root/reference/models/vggish/vggish_src/vggish_slim.py:39-99``:
+input (N, 96, 64) log-mel patches → reshape (N, 96, 64, 1) → VGG stack
+(conv3x3 SAME + ReLU: 64 → pool → 128 → pool → 256×2 → pool → 512×2 → pool) →
+flatten → fc 4096 → fc 4096 → fc 128 (all ReLU, including the embedding layer —
+slim's arg_scope applies relu to fc2 as well). All pools 2×2/2 SAME.
+
+With the fixed 96×64 geometry every pool divides exactly, so SAME == VALID here
+and the flatten is (N, 6, 4, 512) row-major — matching TF's NHWC flatten, which is
+what the checkpoint's fc weights were trained against.
+
+The PCA postprocessor (``vggish_postprocess.py:52-91``) is implemented and wired
+but OFF by default: the reference instantiates it and never applies it
+(``extract_vggish.py:57,104-116`` — SURVEY.md §2.1 #19), so default outputs match.
+
+Param tree follows TF variable naming under ``vggish/`` (conv1, conv3/conv3_1,
+fc1/fc1_1, ...) so an npz exported from the TF checkpoint converts by name.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+NUM_FRAMES = 96
+NUM_BANDS = 64
+EMBEDDING_SIZE = 128
+
+
+class VGGish(nn.Module):
+    """Input (N, 96, 64) or (N, 96, 64, 1) float log-mel patches → (N, 128)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+
+        def conv(name, features, y):
+            y = nn.Conv(features, (3, 3), padding="SAME", dtype=self.dtype, name=name)(y)
+            return nn.relu(y)
+
+        def pool(y):
+            return nn.max_pool(y, (2, 2), strides=(2, 2), padding="SAME")
+
+        x = pool(conv("conv1", 64, x))
+        x = pool(conv("conv2", 128, x))
+        x = pool(conv("conv3_2", 256, conv("conv3_1", 256, x)))
+        x = pool(conv("conv4_2", 512, conv("conv4_1", 512, x)))
+
+        x = x.reshape((x.shape[0], -1))  # NHWC row-major flatten, TF-compatible
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1_1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1_2")(x))
+        # slim's arg_scope puts ReLU on the embedding layer too (vggish_slim.py:65-67)
+        x = nn.relu(nn.Dense(EMBEDDING_SIZE, dtype=self.dtype, name="fc2")(x))
+        return x.astype(jnp.float32)
+
+
+def convert_tf_vggish(tf_vars: Mapping[str, np.ndarray]) -> Dict:
+    """TF checkpoint variables (``vggish/conv1/weights`` HWIO, ``.../biases``) →
+    Flax param tree. Accepts names with or without the ``vggish/`` scope prefix.
+
+    TF conv kernels are already HWIO and fc kernels (in, out) — no transposes;
+    the TF scope path collapses to the leaf module name (``conv3/conv3_1`` →
+    ``conv3_1``).
+    """
+    params: Dict = {}
+    for name, value in tf_vars.items():
+        key = name[len("vggish/"):] if name.startswith("vggish/") else name
+        key = key.replace(":0", "")
+        *scope, leaf = key.split("/")
+        module = scope[-1]  # conv3/conv3_1 → conv3_1; conv1 → conv1
+        leaf = {"weights": "kernel", "biases": "bias"}[leaf]
+        params.setdefault(module, {})[leaf] = np.asarray(value)
+    return params
+
+
+def vggish_init_params(seed: int = 0) -> Dict:
+    """Deterministic random params (the TF init is N(0, 0.01) — vggish_params.py)."""
+    rng = np.random.default_rng(seed)
+    shapes = {
+        "conv1": (3, 3, 1, 64), "conv2": (3, 3, 64, 128),
+        "conv3_1": (3, 3, 128, 256), "conv3_2": (3, 3, 256, 256),
+        "conv4_1": (3, 3, 256, 512), "conv4_2": (3, 3, 512, 512),
+        "fc1_1": (6 * 4 * 512, 4096), "fc1_2": (4096, 4096),
+        "fc2": (4096, EMBEDDING_SIZE),
+    }
+    return {
+        name: {
+            "kernel": (rng.standard_normal(shape) * 0.01).astype(np.float32),
+            "bias": np.zeros(shape[-1], np.float32),
+        }
+        for name, shape in shapes.items()
+    }
+
+
+class Postprocessor:
+    """PCA-whiten + clip [−2, 2] + uint8 quantize (``vggish_postprocess.py:52-91``).
+
+    ``params_npz`` holds ``pca_eigen_vectors`` (128, 128) and ``pca_means`` (128,)
+    — the file the reference ships at ``models/vggish/checkpoints/
+    vggish_pca_params.npz``.
+    """
+
+    QUANTIZE_MIN = -2.0
+    QUANTIZE_MAX = 2.0
+
+    def __init__(self, params_npz: str):
+        with np.load(params_npz) as z:
+            self.eigen_vectors = z["pca_eigen_vectors"].astype(np.float64)
+            self.means = z["pca_means"].reshape(-1, 1).astype(np.float64)
+        if self.eigen_vectors.shape != (EMBEDDING_SIZE, EMBEDDING_SIZE):
+            raise ValueError(f"bad pca_eigen_vectors shape {self.eigen_vectors.shape}")
+        if self.means.shape != (EMBEDDING_SIZE, 1):
+            raise ValueError(f"bad pca_means shape {self.means.shape}")
+
+    def postprocess(self, embeddings: np.ndarray) -> np.ndarray:
+        """(N, 128) float → (N, 128) uint8."""
+        applied = (self.eigen_vectors @ (embeddings.T.astype(np.float64) - self.means)).T
+        clipped = np.clip(applied, self.QUANTIZE_MIN, self.QUANTIZE_MAX)
+        quantized = (clipped - self.QUANTIZE_MIN) * (
+            255.0 / (self.QUANTIZE_MAX - self.QUANTIZE_MIN)
+        )
+        return quantized.astype(np.uint8)

@@ -131,6 +131,32 @@ def convert_raft(state_dict: Mapping) -> dict:
     return params
 
 
+def convert_r21d(state_dict: Mapping) -> dict:
+    """torchvision ``r2plus1d_18`` state_dict → :class:`models.r21d.R2Plus1D18` params.
+
+    Key shapes disambiguate the leaf kind: 5-dim weight → conv3d kernel, 2-dim →
+    fc kernel, 1-dim weight/bias → BatchNorm affine (the only biased layers besides
+    fc are BNs).
+    """
+    sd = to_numpy_state_dict(state_dict)
+    params: dict = {}
+    for key, value in sd.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        *path, leaf = _merge_numeric_tokens(key)
+        if leaf == "weight" and value.ndim == 5:
+            set_path(params, (*path, "kernel"), conv3d_kernel(value))
+        elif leaf == "weight" and value.ndim == 2:
+            set_path(params, (*path, "kernel"), linear_kernel(value))
+        elif leaf in _BN_MAP and value.ndim == 1 and path[-1] != "fc":
+            set_path(params, (*path, _BN_MAP[leaf]), value)
+        elif leaf == "bias":
+            set_path(params, (*path, "bias"), value)
+        else:
+            raise ValueError(f"unrecognized R(2+1)D checkpoint key: {key}")
+    return params
+
+
 def convert_pwc(state_dict: Mapping) -> dict:
     """Reference PWC checkpoint (``pwc_net_sintel.pt``,
     ``/root/reference/models/pwc/pwc_src/pwc_net.py`` naming) → the param pytree of
