@@ -22,6 +22,30 @@ def test_midplanes_matches_torchvision_formula():
     assert midplanes(3, 45) == (3 * 45 * 27) // (3 * 9 + 3 * 45)
 
 
+def test_state_dict_shapes_match_real_torchvision():
+    """Known shapes transcribed from an actual torchvision r2plus1d_18 state_dict
+    (independent of our shape table — guards the shared-table circularity).
+    Torchvision computes midplanes once per block from (inplanes, planes) and
+    reuses it for conv2, so downsampling blocks have 230/460/921 mids on conv2."""
+    from video_features_tpu.models.r21d import r21d_conv_shapes
+
+    shapes = r21d_conv_shapes()
+    expected = {
+        "stem.0": (45, 3, 1, 7, 7),
+        "layer1.0.conv1.0.0": (144, 64, 1, 3, 3),
+        "layer1.0.conv2.0.0": (144, 64, 1, 3, 3),
+        "layer2.0.conv1.0.0": (230, 64, 1, 3, 3),
+        "layer2.0.conv2.0.0": (230, 128, 1, 3, 3),
+        "layer2.0.conv2.0.3": (128, 230, 3, 1, 1),
+        "layer2.1.conv1.0.0": (288, 128, 1, 3, 3),
+        "layer3.0.conv2.0.0": (460, 256, 1, 3, 3),
+        "layer4.0.conv2.0.0": (921, 512, 1, 3, 3),
+        "layer4.1.conv2.0.0": (1152, 512, 1, 3, 3),
+    }
+    for name, shape in expected.items():
+        assert shapes[name] == shape, f"{name}: {shapes[name]} != torchvision {shape}"
+
+
 @pytest.fixture(scope="module")
 def converted():
     sd = r21d_random_state_dict(seed=13)

@@ -4,8 +4,10 @@ Behavioral spec — the reference consumes torchvision's pretrained model with t
 head swapped for identity (``/root/reference/models/r21d/extract_r21d.py:57-62``):
 - stem: (1,7,7)/s(1,2,2) conv → BN → ReLU → (3,1,1) conv → BN → ReLU (45 midplanes);
 - 4 stages of 2 BasicBlocks; every 3D conv is factored spatial (1,3,3) + BN + ReLU +
-  temporal (3,1,1) with midplanes ``⌊in·out·27 / (in·9 + 3·out)⌋``; stages 2–4 open
-  with stride 2 on both the spatial and temporal factors and a (1,1,1)/2 downsample;
+  temporal (3,1,1); midplanes ``⌊in·out·27 / (in·9 + 3·out)⌋`` is computed ONCE per
+  block from (block_in, cout) and shared by both convs (so conv2 of downsampling
+  blocks gets 230/460/921, not a per-conv recomputation); stages 2–4 open with
+  stride 2 on both the spatial and temporal factors and a (1,1,1)/2 downsample;
 - global average pool → 512-d features (fc applied only for ``--show_pred``).
 
 Module names mirror the torchvision state_dict (``stem.0``, ``layer1.0.conv1.0.0``,
@@ -60,12 +62,15 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        mid1 = midplanes(self.cin, self.cout)
-        mid2 = midplanes(self.cout, self.cout)
-        y = Conv2Plus1D(self.cout, mid1, self.stride, self.dtype, name="conv1.0")(x)
+        # torchvision computes midplanes ONCE per block from (inplanes, planes)
+        # and passes the same value to both conv_builder calls
+        # (torchvision/models/video/resnet.py BasicBlock.__init__); conv2 does
+        # NOT recompute from (planes, planes).
+        mid = midplanes(self.cin, self.cout)
+        y = Conv2Plus1D(self.cout, mid, self.stride, self.dtype, name="conv1.0")(x)
         y = TorchBatchNorm(dtype=self.dtype, name="conv1.1")(y)
         y = nn.relu(y)
-        y = Conv2Plus1D(self.cout, mid2, 1, self.dtype, name="conv2.0")(y)
+        y = Conv2Plus1D(self.cout, mid, 1, self.dtype, name="conv2.0")(y)
         y = TorchBatchNorm(dtype=self.dtype, name="conv2.1")(y)
         if self.stride != 1 or self.cin != self.cout:
             x = nn.Conv(self.cout, (1, 1, 1), strides=(self.stride,) * 3,
@@ -144,15 +149,15 @@ def r21d_conv_shapes() -> Dict[str, Tuple]:
         for blk in range(2):
             p = f"layer{stage}.{blk}"
             block_in = cin if blk == 0 else cout
-            mid1 = midplanes(block_in, cout)
-            mid2 = midplanes(cout, cout)
-            shapes[f"{p}.conv1.0.0"] = (mid1, block_in, 1, 3, 3)
-            shapes[f"{p}.conv1.0.1"] = ("bn", mid1)
-            shapes[f"{p}.conv1.0.3"] = (cout, mid1, 3, 1, 1)
+            # one midplanes per block, shared by conv1 and conv2 (torchvision)
+            mid = midplanes(block_in, cout)
+            shapes[f"{p}.conv1.0.0"] = (mid, block_in, 1, 3, 3)
+            shapes[f"{p}.conv1.0.1"] = ("bn", mid)
+            shapes[f"{p}.conv1.0.3"] = (cout, mid, 3, 1, 1)
             shapes[f"{p}.conv1.1"] = ("bn", cout)
-            shapes[f"{p}.conv2.0.0"] = (mid2, cout, 1, 3, 3)
-            shapes[f"{p}.conv2.0.1"] = ("bn", mid2)
-            shapes[f"{p}.conv2.0.3"] = (cout, mid2, 3, 1, 1)
+            shapes[f"{p}.conv2.0.0"] = (mid, cout, 1, 3, 3)
+            shapes[f"{p}.conv2.0.1"] = ("bn", mid)
+            shapes[f"{p}.conv2.0.3"] = (cout, mid, 3, 1, 1)
             shapes[f"{p}.conv2.1"] = ("bn", cout)
             if blk == 0 and stage > 1:
                 shapes[f"{p}.downsample.0"] = (cout, block_in, 1, 1, 1)
