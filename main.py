@@ -36,6 +36,16 @@ def _honor_jax_platforms_env() -> None:
 def main(argv=None) -> int:
     _honor_jax_platforms_env()
     cfg = parse_args(argv)
+
+    # Multi-host bootstrap (DCN): must precede the first device access so every
+    # process sees the global topology; no-op on single-host jobs.
+    from video_features_tpu.parallel import maybe_initialize_distributed
+
+    if maybe_initialize_distributed():
+        import jax
+
+        print(f"multi-host job: process {jax.process_index()}/{jax.process_count()}")
+
     extractor = get_extractor(cfg)
     paths = extractor.video_list()
     if not paths:

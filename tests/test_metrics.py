@@ -1,0 +1,75 @@
+"""Observability: stage clock semantics and the opt-in per-video report."""
+
+import time
+
+import numpy as np
+import pytest
+
+from video_features_tpu.utils.metrics import StageClock, maybe_profiler, metrics_enabled
+
+
+def test_stage_clock_accumulates():
+    c = StageClock()
+    with c.stage("decode"):
+        time.sleep(0.01)
+    with c.stage("decode"):
+        pass
+    assert c.counts["decode"] == 2
+    assert c.seconds["decode"] >= 0.01
+
+
+def test_timed_iter_attributes_blocking_time():
+    c = StageClock()
+
+    def slow_gen():
+        for i in range(3):
+            time.sleep(0.005)
+            yield i
+
+    assert list(c.timed_iter(slow_gen(), "decode")) == [0, 1, 2]
+    assert c.counts["decode"] == 3
+    assert c.seconds["decode"] >= 0.015
+
+
+def test_report_format():
+    c = StageClock()
+    with c.stage("decode"):
+        pass
+    line = c.report("vid.mp4", wall=1.0)
+    assert "vid.mp4" in line and "decode" in line and "overlapped/other" in line
+
+
+def test_metrics_enabled_gates():
+    assert metrics_enabled("/tmp/x")
+    assert not metrics_enabled(None)
+
+
+def test_maybe_profiler_noop():
+    with maybe_profiler(None):
+        pass  # must not require jax
+
+
+def test_run_prints_stage_report(tmp_path, sample_video, monkeypatch, capsys):
+    monkeypatch.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    monkeypatch.setenv("VFT_METRICS", "1")
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+
+    cfg = ExtractionConfig(
+        feature_type="resnet50", batch_size=64, extraction_fps=2, num_devices=1,
+        on_extraction="save_numpy", output_path=str(tmp_path / "o"),
+        tmp_path=str(tmp_path / "t"),
+    )
+    ex = ExtractResNet50(cfg)
+    assert ex.run([sample_video]) == 1
+    out = capsys.readouterr().out
+    assert "decode" in out and "device_wait" in out
+    assert "videos/sec" in out
+
+
+def test_distributed_noop_without_env(monkeypatch):
+    monkeypatch.delenv("VFT_MULTIHOST", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    from video_features_tpu.parallel import maybe_initialize_distributed
+
+    assert maybe_initialize_distributed() is False

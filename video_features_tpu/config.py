@@ -57,6 +57,16 @@ class ExtractionConfig:
     resume: bool = False
     # Host→HBM prefetch depth (double buffering by default).
     prefetch_depth: int = 2
+    # RAFT correlation: "volume" materializes the all-pairs pyramid (reference
+    # default); "on_demand" is the alt_cuda_corr equivalent — O(H·W·D) memory.
+    raft_corr: str = "volume"
+    # PWC cost volume: "xla" fused formulation (default) or the "pallas" tile
+    # kernel (ops/pallas_corr).
+    pwc_corr: str = "xla"
+    # jax.profiler trace directory; also enables the per-video stage report
+    # (decode vs device_wait vs overlapped time). VFT_METRICS=1 enables the
+    # report without tracing.
+    profile_dir: Optional[str] = None
 
     def validate(self) -> None:
         """Mirror the reference ``sanity_check`` (``utils/utils.py:88-105``)."""
@@ -84,6 +94,10 @@ class ExtractionConfig:
             raise ValueError("batch_size must be >= 1")
         if self.clips_per_batch < 1:
             raise ValueError("clips_per_batch must be >= 1")
+        if self.raft_corr not in ("volume", "on_demand"):
+            raise ValueError("raft_corr must be 'volume' or 'on_demand'")
+        if self.pwc_corr not in ("xla", "pallas"):
+            raise ValueError("pwc_corr must be 'xla' or 'pallas'")
 
     def replace(self, **kw) -> "ExtractionConfig":
         return dataclasses.replace(self, **kw)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import abc
 import os
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -22,6 +23,8 @@ from ..io.output import (
     load_done_set,
     mark_done,
 )
+from ..parallel import MeshRunner
+from ..utils.metrics import StageClock, maybe_profiler, metrics_enabled
 
 
 class Extractor(abc.ABC):
@@ -35,12 +38,33 @@ class Extractor(abc.ABC):
         # per-feature-type subdirs, as the reference joins them (extract_i3d.py:77-78)
         self.output_dir = feature_output_dir(cfg.output_path, cfg.feature_type)
         self.tmp_dir = os.path.join(cfg.tmp_path, cfg.feature_type)
+        # data-parallel mesh every device step runs on; --num_devices selects the
+        # mesh size (None = all local devices), replacing the reference's
+        # thread-per-GPU dispatch (/root/reference/main.py:37-47)
+        self.runner = MeshRunner(cfg.num_devices)
+        # per-video stage clock; active only when metrics are enabled (run())
+        self.clock: Optional[StageClock] = None
 
     # --- per-model API ---
 
     @abc.abstractmethod
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         """Extract features for one video; keys become output-file suffixes."""
+
+    # --- observability hooks (no-ops unless metrics are enabled) ---
+
+    def _timed_frames(self, frames_iter):
+        """Attribute host time blocked on decode/transform to the 'decode' stage."""
+        if self.clock is None:
+            return frames_iter
+        return self.clock.timed_iter(frames_iter, "decode")
+
+    def _wait(self, device_out) -> np.ndarray:
+        """Gather a device result, attributing blocked time to 'device_wait'."""
+        if self.clock is None:
+            return np.asarray(device_out)
+        with self.clock.stage("device_wait"):
+            return np.asarray(device_out)
 
     # --- shared driver ---
 
@@ -54,26 +78,44 @@ class Extractor(abc.ABC):
         """
         paths = list(video_paths) if video_paths is not None else self.video_list()
         done = load_done_set(self.output_dir) if self.cfg.resume else set()
+        with_metrics = metrics_enabled(self.cfg.profile_dir)
         ok = 0
-        for n, path in enumerate(paths, start=1):
-            if os.path.abspath(path) in done:
-                ok += 1
+        extracted = 0  # excludes resume-skipped videos (throughput honesty)
+        t_run = time.perf_counter()
+        with maybe_profiler(self.cfg.profile_dir):
+            for n, path in enumerate(paths, start=1):
+                if os.path.abspath(path) in done:
+                    ok += 1
+                    if progress:
+                        progress(n, len(paths))
+                    continue
+                self.clock = StageClock() if with_metrics else None
+                t0 = time.perf_counter()
+                try:
+                    feats_dict = self.extract(path)
+                    action_on_extraction(
+                        feats_dict, path, self.output_dir, self.cfg.on_extraction
+                    )
+                    if self.cfg.on_extraction == "save_numpy":
+                        mark_done(self.output_dir, path, feats_dict.keys())
+                    ok += 1
+                    extracted += 1
+                    if self.clock is not None:
+                        print(self.clock.report(path, time.perf_counter() - t0))
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — per-video fault barrier
+                    print(e)
+                    print(f"Extraction failed at: {path} with error (↑). Continuing extraction")
+                finally:
+                    self.clock = None
                 if progress:
                     progress(n, len(paths))
-                continue
-            try:
-                feats_dict = self.extract(path)
-                action_on_extraction(feats_dict, path, self.output_dir, self.cfg.on_extraction)
-                if self.cfg.on_extraction == "save_numpy":
-                    mark_done(self.output_dir, path, feats_dict.keys())
-                ok += 1
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:  # noqa: BLE001 — per-video fault barrier
-                print(e)
-                print(f"Extraction failed at: {path} with error (↑). Continuing extraction")
-            if progress:
-                progress(n, len(paths))
+        if with_metrics and extracted:
+            dt = time.perf_counter() - t_run
+            print(f"extracted {extracted}/{len(paths)} videos "
+                  f"({ok - extracted} resumed) in {dt:.2f}s "
+                  f"({extracted / dt:.3f} videos/sec)")
         return ok
 
 

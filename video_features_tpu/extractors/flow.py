@@ -24,9 +24,6 @@ from typing import Dict, List
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 from ..io.video import open_video
 from ..models.raft import pad_to_multiple_of_8, raft_forward, raft_init_params, unpad
 from ..ops.image import pil_edge_resize
@@ -40,25 +37,31 @@ class ExtractFlow(Extractor):
 
     def __init__(self, cfg):
         super().__init__(cfg)
-        self.batch_size = cfg.batch_size
+        # pairs per device step, rounded to a multiple of the mesh size so the
+        # sharded pair axis divides evenly (tail pairs repeat the last frame)
+        self.batch_size = self.runner.device_batch(cfg.batch_size)
         if self.feature_type == "raft":
-            self.params = resolve_params(
-                "raft-sintel",
-                convert_torch_fn=convert_raft,
-                init_fn=lambda: raft_init_params(seed=0),
+            self.params = self.runner.put_replicated(
+                resolve_params(
+                    "raft-sintel",
+                    convert_torch_fn=convert_raft,
+                    init_fn=lambda: raft_init_params(seed=0),
+                )
             )
-            self._forward = raft_forward
+            self._forward = functools.partial(raft_forward, corr_impl=cfg.raft_corr)
             self._pads_input = True
         elif self.feature_type == "pwc":
             from ..models.pwc import pwc_forward, pwc_init_params
             from ..weights.convert_torch import convert_pwc
 
-            self.params = resolve_params(
-                "pwc-sintel",
-                convert_torch_fn=convert_pwc,
-                init_fn=lambda: pwc_init_params(seed=0),
+            self.params = self.runner.put_replicated(
+                resolve_params(
+                    "pwc-sintel",
+                    convert_torch_fn=convert_pwc,
+                    init_fn=lambda: pwc_init_params(seed=0),
+                )
             )
-            self._forward = pwc_forward
+            self._forward = functools.partial(pwc_forward, corr_impl=cfg.pwc_corr)
             self._pads_input = False
         else:
             raise ValueError(f"not a flow feature type: {self.feature_type}")
@@ -67,11 +70,13 @@ class ExtractFlow(Extractor):
     def _step(self):
         fwd = self._forward
 
-        @jax.jit
-        def step(params, frames):  # frames (B+1, H, W, 3) float32
-            return fwd(params, frames[:-1], frames[1:])
+        # pairs are pre-split on host into (prev, nxt) of equal leading size B so
+        # both shard cleanly along the mesh's data axis (a single (B+1,)-frames
+        # array cannot: pair i needs frames i and i+1 — a halo across shards)
+        def step(params, prev, nxt):  # each (B, H, W, 3) float32
+            return fwd(params, prev, nxt)
 
-        return step
+        return self.runner.jit(step, n_batch_args=2)
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.cfg.side_size, self.cfg.resize_to_smaller_edge)
@@ -84,11 +89,12 @@ class ExtractFlow(Extractor):
             reps = np.repeat(frames[-1:], self.batch_size - n_pairs, axis=0)
             frames = np.concatenate([frames, reps], axis=0)
         if self._pads_input:
-            padded, pads = pad_to_multiple_of_8(frames)
-            flow = np.asarray(self._step(self.params, jnp.asarray(padded)))
+            frames, pads = pad_to_multiple_of_8(frames)
+        prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
+        nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
+        flow = self._wait(self._step(self.params, prev, nxt))
+        if self._pads_input:
             flow = unpad(flow, pads)
-        else:
-            flow = np.asarray(self._step(self.params, jnp.asarray(frames)))
         # NHWC → reference byte layout (B, 2, H, W)
         return flow[:n_pairs].transpose(0, 3, 1, 2)
 
@@ -112,7 +118,7 @@ class ExtractFlow(Extractor):
                 if self.cfg.show_pred:
                     self._show(stack[:-1], flow)
 
-        for rgb, pos in frames_iter:
+        for rgb, pos in self._timed_frames(frames_iter):
             timestamps_ms.append(pos)
             window.append(rgb)
             if len(window) - 1 == self.batch_size:

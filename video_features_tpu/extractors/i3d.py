@@ -14,9 +14,15 @@ Behavioral spec — ``/root/reference/models/i3d/extract_i3d.py``:
 - ``--show_pred``: Kinetics-400 top-5 per stack per stream (``:166-169``);
 - outputs keyed by stream name (``rgb``/``flow``) + fps + timestamps.
 
-TPU design: the ENTIRE stack step — flow net, transform sandwich, I3D — is one
-jitted program per stream set, so flow maps never leave HBM between the flow net
-and the I3D conv stack.
+TPU design (vs the reference's one-stack-at-a-time GPU loop, ``:139-169``):
+- the ENTIRE stack step — flow net, transform sandwich, I3D — is one jitted
+  program per stream, so flow maps never leave HBM between the flow net and the
+  I3D conv stack;
+- ``clips_per_batch`` stacks are batched into each jitted call (the reference has
+  no clip batching at all) and the batch axis is sharded across the device mesh;
+- host decode/stacking overlaps device compute via the prefetcher;
+- ``--dtype bfloat16`` runs the I3D conv stacks in bf16 on the MXU (the flow nets
+  stay fp32 — iterative flow refinement is precision-sensitive).
 """
 
 from __future__ import annotations
@@ -34,10 +40,11 @@ from ..models.i3d import I3D, i3d_preprocess_flow, i3d_preprocess_rgb
 from ..models.pwc import pwc_forward, pwc_init_params
 from ..models.raft import raft_forward, raft_init_params
 from ..ops.image import pil_edge_resize
+from ..parallel import prefetch_to_device
 from ..utils.labels import show_predictions_on_dataset
 from ..weights.convert_torch import convert_i3d, convert_pwc, convert_raft
 from ..weights.store import resolve_params
-from .base import Extractor
+from .base import Extractor, pad_batch
 
 PRE_CROP_SIZE = 256
 CROP_SIZE = 224
@@ -59,13 +66,18 @@ class ExtractI3D(Extractor):
         self.stack_size = cfg.stack_size
         self.step_size = cfg.step_size
         self.flow_type = cfg.flow_type
+        # stacks per device step, rounded to a multiple of the mesh size
+        self.clips_per_batch = self.runner.device_batch(cfg.clips_per_batch)
+        self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
-        self.i3d = {s: I3D(modality=s) for s in self.streams}
+        self.i3d = {s: I3D(modality=s, dtype=self.dtype) for s in self.streams}
         self.i3d_params = {
-            s: resolve_params(
-                f"i3d_{s}",
-                convert_torch_fn=convert_i3d,
-                init_fn=functools.partial(self._random_i3d, s),
+            s: self.runner.put_replicated(
+                resolve_params(
+                    f"i3d_{s}",
+                    convert_torch_fn=convert_i3d,
+                    init_fn=functools.partial(self._random_i3d, s),
+                )
             )
             for s in self.streams
         }
@@ -80,14 +92,20 @@ class ExtractI3D(Extractor):
                     init_fn=lambda: pwc_init_params(seed=0))
             else:
                 raise ValueError(f"unknown flow_type {self.flow_type!r}")
+            # closed over by the jitted flow step (trace-time constants) — pin
+            # them replicated so tracing doesn't re-transfer per compile
+            self.flow_params = self.runner.put_replicated(self.flow_params)
         else:
             self.flow_params = None
 
     def _random_i3d(self, stream: str):
+        from ..weights.store import random_params_like
+
         model = self.i3d[stream]
         c = 3 if stream == "rgb" else 2
         dummy = jnp.zeros((1, 16, CROP_SIZE, CROP_SIZE, c))
-        return model.init(jax.random.PRNGKey(0), dummy, features=False)["params"]
+        init = lambda r, d: model.init(r, d, features=False)  # noqa: E731
+        return random_params_like(init, jax.random.PRNGKey(0), dummy)["params"]
 
     # --- jitted stack steps -------------------------------------------------
 
@@ -95,18 +113,19 @@ class ExtractI3D(Extractor):
     def _rgb_step(self):
         model = self.i3d["rgb"]
         with_pred = self.cfg.show_pred
+        dtype = self.dtype
 
-        @jax.jit
-        def step(params, stack_u8):  # (S+1, H, W, 3) uint8
-            x = i3d_preprocess_rgb(_center_crop_nhwc(stack_u8[:-1], CROP_SIZE))
-            x = x[None]  # (1, S, 224, 224, 3)
+        def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
+            x = i3d_preprocess_rgb(
+                _center_crop_nhwc(stacks_u8[:, :-1], CROP_SIZE), dtype=dtype
+            )  # (N, S, 224, 224, 3)
             feats = model.apply({"params": params}, x, features=True)
             if with_pred:
                 _, logits = model.apply({"params": params}, x, features=False)
                 return feats, logits
             return feats, None
 
-        return step
+        return self.runner.jit(step)
 
     @functools.cached_property
     def _flow_step(self):
@@ -114,41 +133,39 @@ class ExtractI3D(Extractor):
         flow_type = self.flow_type
         flow_params = self.flow_params
         with_pred = self.cfg.show_pred
+        dtype = self.dtype
+        raft_corr = self.cfg.raft_corr
+        pwc_corr = self.cfg.pwc_corr
 
-        @jax.jit
-        def step(params, stack_u8):  # (S+1, H, W, 3) uint8
-            frames = stack_u8.astype(jnp.float32)
+        def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
+            n, sp1, h, w, _c = stacks_u8.shape
+            s = sp1 - 1
+            frames = stacks_u8.astype(jnp.float32)
+            # all N·S consecutive pairs in one flow-net call (flat batch keeps the
+            # mesh-sharded clip axis leading: each device flows its own clips)
+            prev = frames[:, :-1].reshape(n * s, h, w, 3)
+            nxt = frames[:, 1:].reshape(n * s, h, w, 3)
             if flow_type == "raft":
                 # replicate-pad to /8 and, like the reference, never unpad: the
                 # 224 center crop below runs on the padded flow
-                h, w = frames.shape[1:3]
                 ph, pw = (8 - h % 8) % 8, (8 - w % 8) % 8
                 pads = ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
-                padded = jnp.pad(frames, pads, mode="edge")
-                flow = raft_forward(flow_params, padded[:-1], padded[1:])
+                flow = raft_forward(
+                    flow_params, jnp.pad(prev, pads, mode="edge"),
+                    jnp.pad(nxt, pads, mode="edge"), corr_impl=raft_corr)
             else:
-                flow = pwc_forward(flow_params, frames[:-1], frames[1:])
-            x = i3d_preprocess_flow(_center_crop_nhwc(flow, CROP_SIZE))
-            x = x[None]  # (1, S, 224, 224, 2)
+                flow = pwc_forward(flow_params, prev, nxt, corr_impl=pwc_corr)
+            flow = flow.reshape((n, s) + flow.shape[1:])  # (N, S, Hp, Wp, 2)
+            x = i3d_preprocess_flow(_center_crop_nhwc(flow, CROP_SIZE), dtype=dtype)
             feats = model.apply({"params": params}, x, features=True)
             if with_pred:
                 _, logits = model.apply({"params": params}, x, features=False)
                 return feats, logits
             return feats, None
 
-        return step
+        return self.runner.jit(step)
 
     # --- pipeline -----------------------------------------------------------
-
-    def _run_stack(self, feats_dict, stack: List[np.ndarray], video_path, stack_counter):
-        stack_u8 = jnp.asarray(np.stack(stack))
-        for stream in self.streams:
-            step = self._rgb_step if stream == "rgb" else self._flow_step
-            feats, logits = step(self.i3d_params[stream], stack_u8)
-            feats_dict[stream].extend(np.asarray(feats))
-            if logits is not None:
-                print(f"{video_path} @ stack {stack_counter} ({stream} stream)")
-                show_predictions_on_dataset(np.asarray(logits), "kinetics")
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = open_video(
@@ -160,18 +177,50 @@ class ExtractI3D(Extractor):
         )
         feats_dict: Dict[str, list] = {s: [] for s in self.streams}
         timestamps_ms: List[float] = []
-        stack: List[np.ndarray] = []
-        stack_counter = 0
-        for rgb, pos in frames_iter:
-            stack.append(rgb)
-            if len(stack) - 1 == self.stack_size:
-                self._run_stack(feats_dict, stack, video_path, stack_counter)
-                stack = stack[self.step_size :]
-                stack_counter += 1
-                timestamps_ms.append(pos)
-        # trailing partial stack dropped, as in the reference (:216-219)
+        valid_counts: List[int] = []
 
-        out = {s: np.asarray(v, np.float32) for s, v in feats_dict.items()}
+        def stack_batches():
+            stack: List[np.ndarray] = []
+            batch: List[np.ndarray] = []
+            for rgb, pos in self._timed_frames(frames_iter):
+                stack.append(rgb)
+                if len(stack) - 1 == self.stack_size:
+                    batch.append(np.stack(stack))  # (S+1, H, W, 3) uint8
+                    timestamps_ms.append(pos)
+                    stack = stack[self.step_size :]
+                    if len(batch) == self.clips_per_batch:
+                        valid_counts.append(len(batch))
+                        yield np.stack(batch)
+                        batch = []
+            if batch:  # partial clip batch: zero-pad, rows trimmed after the step
+                valid_counts.append(len(batch))
+                yield pad_batch(np.stack(batch), self.clips_per_batch)
+            # trailing partial *stack* dropped, as in the reference (:216-219)
+
+        # host decode/stacking of batch k+1 overlaps device compute of batch k
+        for i, dev_batch in enumerate(
+            prefetch_to_device(
+                stack_batches(),
+                sharding=self.runner.batch_sharding,
+                depth=self.cfg.prefetch_depth,
+            )
+        ):
+            valid = valid_counts[i]
+            for stream in self.streams:
+                step = self._rgb_step if stream == "rgb" else self._flow_step
+                feats, logits = step(self.i3d_params[stream], dev_batch)
+                feats_dict[stream].append(self._wait(feats)[:valid])
+                if logits is not None:
+                    logits = np.asarray(logits)[:valid]
+                    for row, logit in enumerate(logits):
+                        n_stack = i * self.clips_per_batch + row
+                        print(f"{video_path} @ stack {n_stack} ({stream} stream)")
+                        show_predictions_on_dataset(logit[None], "kinetics")
+
+        out = {
+            s: (np.concatenate(v, axis=0) if v else np.zeros((0, 1024), np.float32))
+            for s, v in feats_dict.items()
+        }
         out["fps"] = np.array(meta.fps)
         out["timestamps_ms"] = np.array(timestamps_ms)
         return out

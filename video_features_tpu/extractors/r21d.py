@@ -41,12 +41,16 @@ class ExtractR21D(Extractor):
         cfg = self.cfg  # model defaults resolved by the base class
         self.stack_size = cfg.stack_size
         self.step_size = cfg.step_size
-        self.clips_per_batch = cfg.clips_per_batch
-        self.model = R2Plus1D18()
-        self.params = resolve_params(
-            "r2plus1d_18",
-            convert_torch_fn=convert_r21d,
-            init_fn=self._random_init,
+        # clips per device step, rounded to a multiple of the mesh size
+        self.clips_per_batch = self.runner.device_batch(cfg.clips_per_batch)
+        self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.model = R2Plus1D18(dtype=self.dtype)
+        self.params = self.runner.put_replicated(
+            resolve_params(
+                "r2plus1d_18",
+                convert_torch_fn=convert_r21d,
+                init_fn=self._random_init,
+            )
         )
         if cfg.show_pred and "fc" not in self.params:
             raise ValueError(
@@ -55,21 +59,24 @@ class ExtractR21D(Extractor):
             )
 
     def _random_init(self):
+        from ..weights.store import random_params_like
+
         dummy = jnp.zeros((1, 4, 112, 112, 3))
-        return self.model.init(jax.random.PRNGKey(0), dummy, features=False)["params"]
+        init = lambda r, d: self.model.init(r, d, features=False)  # noqa: E731
+        return random_params_like(init, jax.random.PRNGKey(0), dummy)["params"]
 
     @functools.cached_property
     def _step(self):
         model = self.model
+        dtype = self.dtype
 
-        @jax.jit
         def step(params, clips_u8):  # (N, 16, H, W, 3) uint8 native resolution
             n, t = clips_u8.shape[:2]
             flat = clips_u8.reshape((n * t,) + clips_u8.shape[2:])
-            x = r21d_preprocess(flat).reshape((n, t, 112, 112, 3))
+            x = r21d_preprocess(flat, dtype=dtype).reshape((n, t, 112, 112, 3))
             return model.apply({"params": params}, x, features=True).astype(jnp.float32)
 
-        return step
+        return self.runner.jit(step)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames, _ts = decode_all(
@@ -82,8 +89,8 @@ class ExtractR21D(Extractor):
         for i in range(0, len(slices), self.clips_per_batch):
             chunk = slices[i : i + self.clips_per_batch]
             clips = np.stack([frames[s:e] for s, e in chunk])
-            clips = pad_batch(clips, self.clips_per_batch)
-            feats = np.asarray(self._step(self.params, clips))[: len(chunk)]
+            clips = self.runner.put(pad_batch(clips, self.clips_per_batch))
+            feats = self._wait(self._step(self.params, clips))[: len(chunk)]
             vid_feats.append(feats)
             if self.cfg.show_pred:
                 fc = self.params["fc"]

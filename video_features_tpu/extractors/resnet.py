@@ -37,25 +37,32 @@ CENTER_CROP_SIZE = 224
 class ExtractResNet50(Extractor):
     def __init__(self, cfg):
         super().__init__(cfg)
-        self.batch_size = cfg.batch_size
+        # round the user batch up to a multiple of the mesh size so the sharded
+        # leading axis always divides evenly (tail rows are zero-padded + trimmed)
+        self.batch_size = self.runner.device_batch(cfg.batch_size)
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.model = ResNet50(dtype=self.dtype)
-        self.params = resolve_params(
-            "resnet50",
-            convert_torch_fn=convert_resnet50,
-            init_fn=self._random_init,
+        self.params = self.runner.put_replicated(
+            resolve_params(
+                "resnet50",
+                convert_torch_fn=convert_resnet50,
+                init_fn=self._random_init,
+            )
         )
         if cfg.show_pred and "fc" not in self.params:
             raise ValueError(
                 "--show_pred needs the classifier head, but the resolved resnet50 "
                 "checkpoint has no 'fc' params (feature-only checkpoint)"
             )
-        self._step = jax.jit(self._forward)
+        self._step = self.runner.jit(self._forward)
 
     def _random_init(self):
+        from ..weights.store import random_params_like
+
         rng = jax.random.PRNGKey(0)
         dummy = jnp.zeros((1, CENTER_CROP_SIZE, CENTER_CROP_SIZE, 3), jnp.uint8)
-        return self.model.init(rng, dummy, features=False)["params"]
+        init = lambda r, d: self.model.init(r, d, features=False)  # noqa: E731
+        return random_params_like(init, rng, dummy)["params"]
 
     def _forward(self, params, frames_u8):
         x = preprocess_frames(frames_u8, dtype=self.dtype)
@@ -79,7 +86,7 @@ class ExtractResNet50(Extractor):
 
         def batches():
             batch = []
-            for rgb, pos in frames:
+            for rgb, pos in self._timed_frames(frames):
                 timestamps_ms.append(pos)
                 batch.append(rgb)
                 if len(batch) == self.batch_size:
@@ -91,11 +98,16 @@ class ExtractResNet50(Extractor):
                 yield pad_batch(np.stack(batch), self.batch_size)
 
         vid_feats = []
-        # decode of batch k+1 overlaps device compute of batch k
+        # decode of batch k+1 overlaps device compute of batch k; the transfer
+        # target is the mesh batch sharding, so frames land pre-split per device
         for i, device_batch in enumerate(
-            prefetch_to_device(batches(), depth=self.cfg.prefetch_depth)
+            prefetch_to_device(
+                batches(),
+                sharding=self.runner.batch_sharding,
+                depth=self.cfg.prefetch_depth,
+            )
         ):
-            feats = np.asarray(self._step(self.params, device_batch))[: valid_counts[i]]
+            feats = self._wait(self._step(self.params, device_batch))[: valid_counts[i]]
             vid_feats.append(feats)
             if self.cfg.show_pred:
                 fc = self.params["fc"]

@@ -23,9 +23,6 @@ from typing import Dict
 
 import numpy as np
 
-import jax
-import jax.numpy as jnp
-
 from ..audio.melspec import wav_to_examples
 from ..io import ffmpeg as ffmpeg_io
 from ..models.vggish import (
@@ -45,11 +42,15 @@ EXAMPLE_BATCH = 32
 class ExtractVGGish(Extractor):
     def __init__(self, cfg):
         super().__init__(cfg)
+        # examples per device step, rounded to a multiple of the mesh size
+        self.example_batch = self.runner.device_batch(EXAMPLE_BATCH)
         self.model = VGGish()
-        self.params = resolve_params(
-            "vggish",
-            convert_torch_fn=convert_tf_vggish,  # npz of TF vars converts by name
-            init_fn=lambda: vggish_init_params(seed=0),
+        self.params = self.runner.put_replicated(
+            resolve_params(
+                "vggish",
+                convert_tf_fn=convert_tf_vggish,  # reference ships a TF-slim checkpoint
+                init_fn=lambda: vggish_init_params(seed=0),
+            )
         )
         # reference parity: processor constructed, applied only on request
         pca_path = os.environ.get("VFT_VGGISH_PCA_PARAMS")
@@ -59,11 +60,10 @@ class ExtractVGGish(Extractor):
     def _step(self):
         model = self.model
 
-        @jax.jit
         def step(params, examples):  # (B, 96, 64) float32
             return model.apply({"params": params}, examples)
 
-        return step
+        return self.runner.jit(step)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         wav_path = video_path
@@ -75,11 +75,11 @@ class ExtractVGGish(Extractor):
         try:
             examples = wav_to_examples(wav_path)  # (N, 96, 64)
             feats = []
-            for i in range(0, len(examples), EXAMPLE_BATCH):
-                chunk = examples[i : i + EXAMPLE_BATCH]
+            for i in range(0, len(examples), self.example_batch):
+                chunk = examples[i : i + self.example_batch]
                 valid = len(chunk)
-                batch = pad_batch(chunk, EXAMPLE_BATCH)
-                feats.append(np.asarray(self._step(self.params, jnp.asarray(batch)))[:valid])
+                batch = self.runner.put(pad_batch(chunk, self.example_batch))
+                feats.append(self._wait(self._step(self.params, batch))[:valid])
             out = (
                 np.concatenate(feats, axis=0)
                 if feats

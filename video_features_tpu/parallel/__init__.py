@@ -1,11 +1,21 @@
-from .mesh import local_mesh, replicate, shard_along, sharded_apply
-from .pipeline import prefetch_to_device, shard_video_list
+from .mesh import (
+    DATA_AXIS,
+    MeshRunner,
+    batch_sharding,
+    local_mesh,
+    replicate,
+    sharded_apply,
+)
+from .pipeline import maybe_initialize_distributed, prefetch_to_device, shard_video_list
 
 __all__ = [
+    "DATA_AXIS",
+    "MeshRunner",
+    "batch_sharding",
     "local_mesh",
     "replicate",
-    "shard_along",
     "sharded_apply",
+    "maybe_initialize_distributed",
     "prefetch_to_device",
     "shard_video_list",
 ]

@@ -2,10 +2,16 @@
 
 The reference's only parallel axis is inter-video data parallelism via one Python
 thread per GPU (``/root/reference/main.py:37-47``). The TPU-native design replaces
-threads with SPMD over a ``jax.sharding.Mesh``: a batch of clips is sharded along the
-leading axis across devices (``data`` axis over ICI), params are replicated, and a
-single jitted program runs everywhere. No collectives are semantically required for
-inference; XLA inserts only the initial shard/replicate transfers.
+threads with SPMD over a ``jax.sharding.Mesh``: a batch of clips/frames/pairs is
+sharded along the leading axis across devices (``data`` axis over ICI), params are
+replicated, and a single jitted program runs everywhere. No collectives are
+semantically required for inference; XLA inserts only the initial shard/replicate
+transfers and the output gather when results return to host.
+
+Every extractor owns a :class:`MeshRunner` (built from ``cfg.num_devices``) and
+routes its batched device step through :meth:`MeshRunner.jit`; batch sizes are
+rounded up to a multiple of the mesh size with :meth:`MeshRunner.device_batch` so
+the leading axis always divides evenly (static shapes — one compile per geometry).
 
 Multi-host (DCN) scaling uses the same code: each host builds a mesh over its local
 devices and processes its shard of the *video list*
@@ -35,29 +41,66 @@ def local_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence] = 
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
-def shard_along(mesh: Mesh, ndim: int, axis: int = 0) -> NamedSharding:
-    """NamedSharding that splits array axis ``axis`` across the data axis."""
-    spec = [None] * ndim
-    spec[axis] = DATA_AXIS
-    return NamedSharding(mesh, P(*spec))
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis across the data axis.
+
+    The PartitionSpec names only axis 0, so the same sharding serves every batch
+    rank in the framework: (B, F) features, (B, H, W, C) frames, (B, T, H, W, C)
+    clip stacks, (B, H, W, 2) flow fields.
+    """
+    return NamedSharding(mesh, P(DATA_AXIS))
 
 
 def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def sharded_apply(mesh: Mesh, fn: Callable, batch_ndim: int, donate_batch: bool = True):
-    """jit ``fn(params, batch)`` with params replicated and batch sharded on axis 0.
+def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1):
+    """jit ``fn(params, *batches)`` with params replicated and batches sharded on axis 0.
 
-    The batch's leading axis must be divisible by the mesh size (callers pad with
-    :func:`video_features_tpu.extractors.base.pad_batch` — static shapes, one compile).
-    Donating the input batch lets XLA reuse its HBM for activations.
+    Each batch argument's leading axis must be divisible by the mesh size — callers
+    round their batch size up via :meth:`MeshRunner.device_batch` and zero-pad the
+    tail (:func:`video_features_tpu.extractors.base.pad_batch`). Output shardings
+    are left to XLA (batch-preserving steps keep rows sharded; ``np.asarray``
+    gathers them to host). Inputs are not donated: the uint8→float first op can't
+    reuse the input buffer anyway (XLA donation warning observed in round 1).
     """
-    in_shardings = (replicate(mesh), shard_along(mesh, batch_ndim))
-    out_shardings = shard_along(mesh, 2)  # (N, feat) features stay row-sharded
-    return jax.jit(
-        fn,
-        in_shardings=in_shardings,
-        out_shardings=out_shardings,
-        donate_argnums=(1,) if donate_batch else (),
-    )
+    in_shardings = (replicate(mesh),) + (batch_sharding(mesh),) * n_batch_args
+    return jax.jit(fn, in_shardings=in_shardings)
+
+
+class MeshRunner:
+    """Per-extractor data-parallel execution context.
+
+    Replaces the reference's thread-per-GPU ``replicate``/``scatter``/
+    ``parallel_apply`` (``/root/reference/main.py:43-47``): instead of replicating a
+    Python module across devices and scattering video indices, the model params are
+    replicated onto a mesh once and every device step is a single SPMD program over
+    a sharded batch.
+    """
+
+    def __init__(self, num_devices: Optional[int] = None):
+        self.mesh = local_mesh(num_devices)
+        self.num_devices = int(self.mesh.devices.size)
+        self.batch_sharding = batch_sharding(self.mesh)
+        self.replicated = replicate(self.mesh)
+
+    def device_batch(self, requested: int) -> int:
+        """Smallest multiple of the mesh size ≥ ``requested``."""
+        return -(-requested // self.num_devices) * self.num_devices
+
+    def jit(self, fn: Callable, n_batch_args: int = 1):
+        return sharded_apply(self.mesh, fn, n_batch_args)
+
+    def put(self, arr):
+        """Transfer a host batch onto the mesh, sharded along axis 0."""
+        return jax.device_put(arr, self.batch_sharding)
+
+    def put_replicated(self, tree):
+        """Place a param pytree on the mesh, replicated, ONCE.
+
+        Host-numpy params passed into a jitted call are re-transferred every
+        call (a full weight-tree H2D copy per batch); extractors must pin their
+        params here at construction.
+        """
+        return jax.device_put(tree, self.replicated)

@@ -14,10 +14,40 @@ shard of the video list — same semantics, no coordinator, resumable per host.
 from __future__ import annotations
 
 import collections
+import os
 from typing import Iterable, Iterator, List, Optional, Sequence
 
 import jax
 import numpy as np
+
+
+def maybe_initialize_distributed() -> bool:
+    """Join a multi-host JAX job when one is configured; no-op otherwise.
+
+    The reference has no multi-host story beyond manually split file lists
+    (``gen_file_list.py``); the TPU runtime's DCN mechanism is
+    ``jax.distributed.initialize`` (SURVEY.md §2.3/§5). Trigger: ``VFT_MULTIHOST=1``
+    (values from the standard JAX env vars / TPU metadata) or an explicit
+    coordinator address in ``JAX_COORDINATOR_ADDRESS``. Must run before the first
+    device access. Returns True when running multi-process.
+    """
+    # NB: must not touch jax.process_count()/jax.devices() before deciding —
+    # any backend-initializing call makes a later jax.distributed.initialize()
+    # raise. Detect an already-initialized service via the distributed client.
+    try:
+        from jax._src import distributed  # noqa: PLC2701 — no public probe exists
+
+        already = distributed.global_state.client is not None
+    except Exception:
+        already = False
+    if already:
+        return jax.process_count() > 1
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if os.environ.get("VFT_MULTIHOST") == "1" or coord:
+        kwargs = {"coordinator_address": coord} if coord else {}
+        jax.distributed.initialize(**kwargs)
+        return jax.process_count() > 1
+    return False
 
 
 def shard_video_list(

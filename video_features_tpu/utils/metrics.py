@@ -1,0 +1,80 @@
+"""Per-stage timing and profiler hooks (SURVEY.md §5: the reference has tqdm
+bars and nothing else; diagnosing whether decode, transfer, or compute bounds a
+run is the whole perf game on TPU).
+
+Opt-in: ``--profile_dir DIR`` wraps the run in a ``jax.profiler`` trace (view
+with TensorBoard/XProf) and enables the per-video stage report; ``VFT_METRICS=1``
+enables the report alone.
+
+Stage semantics (async device dispatch makes naive timing lie):
+- ``decode``: host time blocked pulling frames from the decoder/transform
+  iterator — real decode-bound time.
+- ``device_wait``: host time blocked on device results (``np.asarray`` /
+  ``block_until_ready``) — compute-bound time NOT hidden by prefetch.
+- ``wall``: end-to-end per video. ``wall − decode − device_wait`` ≈ host
+  stacking/bookkeeping overlapped with device work.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import time
+from typing import Dict, Iterable, Iterator
+
+
+def metrics_enabled(profile_dir=None) -> bool:
+    return bool(profile_dir) or os.environ.get("VFT_METRICS") == "1"
+
+
+class StageClock:
+    """Accumulates seconds per named stage."""
+
+    def __init__(self):
+        self.seconds: Dict[str, float] = collections.defaultdict(float)
+        self.counts: Dict[str, int] = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def timed_iter(self, it: Iterable, name: str) -> Iterator:
+        """Wrap an iterator, attributing time blocked in ``next()`` to ``name``."""
+        it = iter(it)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                self.seconds[name] += time.perf_counter() - t0
+                return
+            self.seconds[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+            yield item
+
+    def report(self, label: str, wall: float) -> str:
+        parts = [f"{label}: wall {wall:.2f}s"]
+        for name in sorted(self.seconds):
+            parts.append(f"{name} {self.seconds[name]:.2f}s/{self.counts[name]}")
+        accounted = sum(self.seconds.values())
+        parts.append(f"overlapped/other {max(wall - accounted, 0.0):.2f}s")
+        return " | ".join(parts)
+
+
+@contextlib.contextmanager
+def maybe_profiler(profile_dir=None):
+    """``jax.profiler`` trace context when a directory is given, else no-op."""
+    if not profile_dir:
+        yield
+        return
+    import jax
+
+    os.makedirs(profile_dir, exist_ok=True)
+    with jax.profiler.trace(profile_dir):
+        yield

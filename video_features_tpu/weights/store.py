@@ -67,14 +67,58 @@ def _candidates(name: str):
             yield os.path.join(d, name + ext)
 
 
+def random_params_like(init_fn: Callable, *args, seed: int = 0) -> dict:
+    """Random params with the tree/shape/dtype structure of ``init_fn(*args)``
+    WITHOUT tracing it on a device — ``jax.eval_shape`` only.
+
+    Flax ``model.init`` compiles and runs a full forward pass (minutes of XLA
+    compile for the conv3d networks on TPU, all wasted for random weights).
+    Leaf semantics follow the param name: BatchNorm ``var``/``scale`` → ones,
+    ``mean``/``bias`` → zeros, kernels → He-scaled normals (fan-in from the
+    HWIO/(in, out) layout) so deep stacks keep O(1) activations — random-weight
+    parity tests then compare numbers of sane magnitude.
+    """
+    import jax
+
+    shapes = jax.eval_shape(init_fn, *args)
+    rng = np.random.default_rng(seed)
+
+    def leaf(path, s):
+        name = getattr(path[-1], "key", str(path[-1]))
+        if name in ("var", "scale"):
+            return np.ones(s.shape, s.dtype)
+        if name in ("mean", "bias"):
+            return np.zeros(s.shape, s.dtype)
+        fan_in = int(np.prod(s.shape[:-1])) or 1
+        std = (2.0 / fan_in) ** 0.5
+        return (rng.standard_normal(s.shape) * std).astype(s.dtype)
+
+    return jax.tree_util.tree_map_with_path(leaf, shapes)
+
+
+def looks_like_tf_vars(flat: Dict[str, np.ndarray]) -> bool:
+    """TF-slim variable naming (``vggish/conv1/weights``) vs store-format flat
+    Flax keys (``conv1/kernel``)."""
+    return any(
+        k.replace(":0", "").rsplit("/", 1)[-1] in ("weights", "biases") for k in flat
+    )
+
+
 def resolve_params(
     name: str,
     convert_torch_fn: Optional[Callable[[dict], dict]] = None,
     init_fn: Optional[Callable[[], dict]] = None,
     checkpoint_path: Optional[str] = None,
     allow_random: bool = False,
+    convert_tf_fn: Optional[Callable[[Dict[str, np.ndarray]], dict]] = None,
 ) -> dict:
-    """Return the Flax param tree for model ``name`` per the resolution order above."""
+    """Return the Flax param tree for model ``name`` per the resolution order above.
+
+    ``convert_tf_fn``: converter for an ``.npz`` holding RAW TF checkpoint
+    variables (the reference VGGish ships as a TF-slim checkpoint,
+    ``vggish_slim.py:102-129``); detected by TF-style variable names so a
+    TF-vars dump and a store-format params file can share the ``.npz`` slot.
+    """
     if checkpoint_path and not os.path.exists(checkpoint_path):
         # an explicit path must not silently degrade to random weights
         raise FileNotFoundError(f"checkpoint_path {checkpoint_path!r} does not exist")
@@ -83,7 +127,11 @@ def resolve_params(
         if path is None or not os.path.exists(path):
             continue
         if path.endswith(".npz"):
-            return load_params_npz(path)
+            with np.load(path) as z:
+                flat = {k: z[k] for k in z.files}
+            if convert_tf_fn is not None and looks_like_tf_vars(flat):
+                return convert_tf_fn(flat)
+            return unflatten_params(flat)
         if convert_torch_fn is None:
             raise ValueError(f"{path}: torch checkpoint given but no converter for {name}")
         import torch  # local import: torch is host-side tooling only
