@@ -1,0 +1,98 @@
+"""Correlation implementations: Pallas kernel and on-demand RAFT lookup must
+match the parity-proven defaults (reference CUDA semantics:
+correlation.py:44-112, corr.py:12-91)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from video_features_tpu.ops.pallas_corr import corr81, corr81_pallas, corr81_xla
+
+
+@pytest.fixture
+def fmaps(rng):
+    f1 = rng.normal(size=(2, 12, 16, 32)).astype(np.float32)
+    f2 = rng.normal(size=(2, 12, 16, 32)).astype(np.float32)
+    return jnp.asarray(f1), jnp.asarray(f2)
+
+
+def test_corr81_xla_semantics(fmaps):
+    """Channel k=(dy+4)*9+(dx+4) is the mean-over-channels shifted product."""
+    f1, f2 = fmaps
+    out = np.asarray(corr81_xla(f1, f2))
+    assert out.shape == (2, 12, 16, 81)
+    # spot-check the zero-displacement tap (k=40) and one shifted tap
+    np.testing.assert_allclose(
+        out[..., 40], np.mean(np.asarray(f1) * np.asarray(f2), -1), rtol=1e-5
+    )
+    dy, dx = 1, -2  # k = (1+4)*9 + (-2+4) = 47
+    f2p = np.pad(np.asarray(f2), ((0, 0), (4, 4), (4, 4), (0, 0)))
+    shifted = f2p[:, 4 + dy : 16 + dy, 4 + dx : 20 + dx, :]
+    np.testing.assert_allclose(out[..., 47], np.mean(np.asarray(f1) * shifted, -1),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_corr81_pallas_matches_xla(fmaps):
+    """The tile kernel (interpreter mode on CPU) equals the XLA formulation."""
+    f1, f2 = fmaps
+    ref = np.asarray(corr81_xla(f1, f2))
+    out = np.asarray(corr81_pallas(f1, f2, interpret=True))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_corr81_dispatcher(fmaps):
+    f1, f2 = fmaps
+    ref = np.asarray(corr81(f1, f2, "xla"))
+    out = np.asarray(corr81(f1, f2, "pallas_interpret"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        corr81(f1, f2, "cuda")
+
+
+def test_pwc_forward_pallas_corr_matches(rng):
+    """End-to-end PWC flow with the Pallas cost volume == XLA cost volume."""
+    from video_features_tpu.models.pwc import pwc_forward, pwc_init_params
+
+    params = pwc_init_params(seed=0)
+    im1 = jnp.asarray(rng.uniform(0, 255, (1, 64, 64, 3)).astype(np.float32))
+    im2 = jnp.asarray(rng.uniform(0, 255, (1, 64, 64, 3)).astype(np.float32))
+    ref = np.asarray(pwc_forward(params, im1, im2, corr_impl="xla"))
+    # interpret-mode Pallas via monkeypatched dispatch is unwieldy inside jit;
+    # on CPU the pallas impl falls back through corr81's VMEM check only on
+    # size, so call the interpreter variant explicitly through corr_impl
+    out = np.asarray(pwc_forward(params, im1, im2, corr_impl="pallas_interpret"))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_raft_on_demand_lookup_matches_volume(rng):
+    """⟨f1, pool(f2)⟩ on-demand lookup == lookup of the pooled volume."""
+    from video_features_tpu.models.raft import (
+        _build_f2_pyramid,
+        _build_pyramid,
+        _lookup,
+        _lookup_on_demand,
+    )
+
+    f1 = jnp.asarray(rng.normal(size=(2, 16, 16, 32)).astype(np.float32))
+    f2 = jnp.asarray(rng.normal(size=(2, 16, 16, 32)).astype(np.float32))
+    coords = jnp.asarray(
+        rng.uniform(-2, 18, (2, 16, 16, 2)).astype(np.float32)  # incl. out-of-bounds
+    )
+    ref = np.asarray(_lookup(_build_pyramid(f1, f2), coords))
+    out = np.asarray(_lookup_on_demand(f1, _build_f2_pyramid(f2), coords))
+    assert out.shape == ref.shape == (2, 16, 16, 324)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_raft_forward_on_demand_matches_volume(rng):
+    """Full RAFT forward, both correlation implementations (4 iterations —
+    random-weight chaos grows with depth)."""
+    from video_features_tpu.models.raft import raft_forward, raft_init_params
+
+    params = raft_init_params(seed=0)
+    im1 = jnp.asarray(rng.uniform(0, 255, (1, 64, 72, 3)).astype(np.float32))
+    im2 = jnp.asarray(rng.uniform(0, 255, (1, 64, 72, 3)).astype(np.float32))
+    ref = np.asarray(raft_forward(params, im1, im2, iters=4, corr_impl="volume"))
+    out = np.asarray(raft_forward(params, im1, im2, iters=4, corr_impl="on_demand"))
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)

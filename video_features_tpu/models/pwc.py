@@ -15,9 +15,9 @@ Behavioral spec — ``/root/reference/models/pwc/pwc_src/pwc_net.py``:
 - Output: 20 × bilinear resize of (flow₂ + refinement) to the *original* size, u
   scaled by W/W₆₄, v by H/H₆₄ (``:256-261``).
 
-The cost volume here is 81 shifted elementwise products reduced over channels —
-XLA fuses this into a handful of HBM-friendly passes; a Pallas kernel slot exists in
-:mod:`video_features_tpu.ops.pallas_corr` for the hand-tiled version.
+The cost volume lives in :mod:`video_features_tpu.ops.pallas_corr`: a pure-XLA
+formulation (default — 81 shifted products XLA fuses into HBM-friendly passes)
+and a hand-tiled Pallas kernel, selected by ``corr_impl``.
 
 Functional over a param pytree (torch checkpoint names, e.g.
 ``moduleExtractor.moduleOne.0`` — see
@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.nnf import conv2d, conv2d_transpose, leaky_relu
+from ..ops.pallas_corr import corr81
 from ..ops.warp import resize_bilinear_torch, warp_backward
 
 CORR_RADIUS = 4
@@ -47,23 +48,8 @@ DENSE_OUT = (128, 128, 96, 64, 32)  # moduleOne..moduleFiv
 LEVEL_NAMES = {2: "moduleTwo", 3: "moduleThr", 4: "moduleFou", 5: "moduleFiv", 6: "moduleSix"}
 
 
-def correlation_81(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
-    """Channel-mean cost volume over the 9×9 displacement window.
-
-    out[b, y, x, k] = mean_c f1[b, y, x, c] · f2[b, y+dy, x+dx, c], zero-padded,
-    k = (dy+4)·9 + (dx+4) — the reference CUDA kernel's channel order
-    (``correlation.py:79-81``).
-    """
-    b, h, w, c = f1.shape
-    r = CORR_RADIUS
-    f2p = jnp.pad(f2, ((0, 0), (r, r), (r, r), (0, 0)))
-    f1 = f1.astype(jnp.float32)
-    taps = []
-    for dy in range(-r, r + 1):
-        for dx in range(-r, r + 1):
-            shifted = f2p[:, r + dy : r + dy + h, r + dx : r + dx + w, :].astype(jnp.float32)
-            taps.append(jnp.mean(f1 * shifted, axis=-1))
-    return jnp.stack(taps, axis=-1)
+# re-export: tests and external callers address the cost volume through the model
+from ..ops.pallas_corr import corr81_xla as correlation_81  # noqa: E402, F401
 
 
 def _pyramid(p: Dict, x: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
@@ -79,16 +65,17 @@ def _pyramid(p: Dict, x: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
     return tuple(feats)
 
 
-def _decoder(p: Dict, level: int, f1: jnp.ndarray, f2: jnp.ndarray, prev):
+def _decoder(p: Dict, level: int, f1: jnp.ndarray, f2: jnp.ndarray, prev,
+             corr_impl: str = "xla"):
     """One coarse-to-fine stage (pwc_net.py:152-187)."""
     if prev is None:
-        volume = leaky_relu(correlation_81(f1, f2))
+        volume = leaky_relu(corr81(f1, f2, corr_impl))
         feat = volume
     else:
         flow = conv2d_transpose(p["moduleUpflow"], prev["flow"])
         upfeat = conv2d_transpose(p["moduleUpfeat"], prev["feat"])
         warped = warp_backward(f2, flow * DEC_BACKWARD[level])
-        volume = leaky_relu(correlation_81(f1, warped))
+        volume = leaky_relu(corr81(f1, warped, corr_impl))
         feat = jnp.concatenate([volume, f1, flow, upfeat], axis=-1)
 
     for name in ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv"):
@@ -106,9 +93,13 @@ def _refiner(p: Dict, feat: jnp.ndarray) -> jnp.ndarray:
     return conv2d(p["12"], x, 1, 1)
 
 
-def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray) -> jnp.ndarray:
+def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
+                corr_impl: str = "xla") -> jnp.ndarray:
     """Flow frame1→frame2. Inputs (B, H, W, 3) float RGB [0, 255], any size.
-    Returns (B, H, W, 2) flow in input-resolution pixels."""
+    Returns (B, H, W, 2) flow in input-resolution pixels.
+
+    ``corr_impl``: cost-volume implementation (``xla`` | ``pallas``), see
+    :mod:`video_features_tpu.ops.pallas_corr`."""
     b, h, w, _ = image1.shape
     x1 = image1[..., ::-1].astype(jnp.float32) / 255.0  # RGB → BGR (pwc_net.py:230)
     x2 = image2[..., ::-1].astype(jnp.float32) / 255.0
@@ -125,7 +116,7 @@ def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray) -> jnp.n
     est = None
     for level in (6, 5, 4, 3, 2):
         est = _decoder(params[LEVEL_NAMES[level]], level,
-                       pyr1[level - 1], pyr2[level - 1], est)
+                       pyr1[level - 1], pyr2[level - 1], est, corr_impl)
 
     flow = est["flow"] + _refiner(params["moduleRefiner"]["moduleMain"], est["feat"])
     flow = 20.0 * resize_bilinear_torch(flow, h, w)

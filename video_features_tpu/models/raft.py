@@ -88,26 +88,61 @@ def _build_pyramid(f1: jnp.ndarray, f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
     return tuple(pyramid)
 
 
-def _lookup(pyramid, coords: jnp.ndarray) -> jnp.ndarray:
-    """9×9 bilinear window per level around the current correspondence.
-
-    Reproduces the reference's delta-grid axis swap (corr.py:37-43): grid position
-    (i, j) samples displacement (δ_i in x, δ_j in y), flattened i-major into 81
-    channels per level.
-    """
-    b, h, w, _ = coords.shape
+def _delta_grid() -> jnp.ndarray:
+    """(9, 9, 2) window offsets with the reference's axis swap (corr.py:37-43):
+    grid position (i, j) samples displacement (δ_i in x, δ_j in y)."""
     r = CORR_RADIUS
     d = jnp.arange(-r, r + 1, dtype=jnp.float32)
     dx = jnp.broadcast_to(d[:, None], (2 * r + 1, 2 * r + 1))  # varies along axis 0
     dy = jnp.broadcast_to(d[None, :], (2 * r + 1, 2 * r + 1))  # varies along axis 1
-    delta = jnp.stack([dx, dy], axis=-1)  # (9, 9, 2) in (x, y) order
+    return jnp.stack([dx, dy], axis=-1)  # (x, y) order
 
+
+def _lookup(pyramid, coords: jnp.ndarray) -> jnp.ndarray:
+    """9×9 bilinear window per level around the current correspondence,
+    flattened i-major into 81 channels per level."""
+    b, h, w, _ = coords.shape
+    delta = _delta_grid()
     out = []
     for i, corr in enumerate(pyramid):
         centroid = (coords / 2**i).reshape(b * h * w, 1, 1, 2)
         sampled = bilinear_sample(corr, centroid + delta)  # (BHW, 9, 9, 1)
-        out.append(sampled.reshape(b, h, w, (2 * r + 1) ** 2))
+        out.append(sampled.reshape(b, h, w, (2 * CORR_RADIUS + 1) ** 2))
     return jnp.concatenate(out, axis=-1)  # (B, H, W, 4·81)
+
+
+def _build_f2_pyramid(f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """Pooled TARGET features for on-demand correlation.
+
+    The TPU-native equivalent of the reference's optional ``alt_cuda_corr``
+    extension (corr.py:63-91): instead of materializing the (H·W)² volume,
+    exploit linearity — avg-pooling the volume over target coordinates equals
+    correlating against avg-pooled f2, and bilinear lookup is linear too, so
+    ``sample(pool(corr))(x, p) == ⟨f1(x), sample(pool(f2))(p)⟩``. Memory drops
+    from O((H·W)²) to O(H·W·D); FLOPs drop too once H·W > 81·levels·iters.
+    """
+    pyr = [f2]
+    for _ in range(CORR_LEVELS - 1):
+        pyr.append(avg_pool2d(pyr[-1], 2, 2))
+    return tuple(pyr)
+
+
+def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray) -> jnp.ndarray:
+    """Correlation window computed on the fly: gather pooled-f2 features at the
+    81 window points per level, dot with f1. Identical numerics to
+    ``_lookup(_build_pyramid(...))`` up to fp reduction order."""
+    b, h, w, d = f1.shape
+    delta = _delta_grid()  # (9, 9, 2)
+    scale = 1.0 / math.sqrt(d)
+    f1 = f1.astype(jnp.float32)
+    n_tap = (2 * CORR_RADIUS + 1) ** 2
+    out = []
+    for i, f2i in enumerate(f2_pyramid):
+        pts = coords.reshape(b, h * w, 1, 1, 2) / 2**i + delta  # (B, HW, 9, 9, 2)
+        smp = bilinear_sample(f2i, pts.reshape(b, h * w * n_tap, 1, 2))
+        smp = smp.reshape(b, h, w, n_tap, d)
+        out.append(jnp.einsum("bhwc,bhwkc->bhwk", f1, smp) * scale)
+    return jnp.concatenate(out, axis=-1)
 
 
 def _motion_encoder(p: dict, flow: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarray:
@@ -143,15 +178,33 @@ def _convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 
 def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
-                 iters: int = ITERS) -> jnp.ndarray:
+                 iters: int = ITERS, taps: Dict = None,
+                 corr_impl: str = "volume") -> jnp.ndarray:
     """Flow from frame1 to frame2. Inputs (B, H, W, 3) float RGB in [0, 255],
-    H and W divisible by 8. Returns (B, H, W, 2) flow in pixels (u, v)."""
+    H and W divisible by 8. Returns (B, H, W, 2) flow in pixels (u, v).
+
+    ``corr_impl``: ``volume`` materializes the all-pairs pyramid (reference
+    default path, corr.py:12-60); ``on_demand`` computes window correlations per
+    iteration from pooled f2 features (the ``alt_cuda_corr`` equivalent —
+    O(H·W·D) memory instead of O((H·W)²), see :func:`_build_f2_pyramid`).
+
+    ``taps``: debug-only dict filled with per-stage activations (fnet/cnet/corr/
+    per-iteration flow) for the layer-diff parity harness (tools/layer_diff.py);
+    tapping unrolls the update loop in Python instead of ``lax.scan``.
+    """
+    if corr_impl not in ("volume", "on_demand"):
+        raise ValueError(f"corr_impl must be volume|on_demand, got {corr_impl!r}")
     x1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
     x2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
 
     f1 = _encoder(params["fnet"], x1, "instance").astype(jnp.float32)
     f2 = _encoder(params["fnet"], x2, "instance").astype(jnp.float32)
-    pyramid = _build_pyramid(f1, f2)
+    if corr_impl == "volume":
+        pyramid = _build_pyramid(f1, f2)
+        lookup = lambda coords: _lookup(pyramid, coords)  # noqa: E731
+    else:
+        f2_pyramid = _build_f2_pyramid(f2)
+        lookup = lambda coords: _lookup_on_demand(f1, f2_pyramid, coords)  # noqa: E731
 
     cnet = _encoder(params["cnet"], x1, "batch")
     net = jnp.tanh(cnet[..., :HIDDEN_DIM])
@@ -161,9 +214,13 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     coords0 = coords_grid(b, h8, w8)
     up = params["update_block"]
 
+    if taps is not None:
+        taps["fnet1"], taps["fnet2"], taps["cnet"] = f1, f2, cnet
+        taps["corr_l0"] = _build_pyramid(f1, f2)[0]
+
     def body(carry, _):
         net, coords1 = carry
-        corr = _lookup(pyramid, coords1)
+        corr = lookup(coords1)
         flow = coords1 - coords0
         motion = _motion_encoder(up["encoder"], flow, corr)
         net = _sep_conv_gru(up["gru"], net, jnp.concatenate([inp, motion], -1))
@@ -171,7 +228,13 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
                        _relu(conv2d(up["flow_head"]["conv1"], net, 1, 1)), 1, 1)
         return (net, coords1 + delta), None
 
-    (net, coords1), _ = lax.scan(body, (net, coords0), None, length=iters)
+    if taps is None:
+        (net, coords1), _ = lax.scan(body, (net, coords0), None, length=iters)
+    else:
+        coords1 = coords0
+        for it in range(iters):
+            (net, coords1), _ = body((net, coords1), None)
+            taps[f"flow_iter{it}"] = coords1 - coords0
 
     mask = 0.25 * conv2d(up["mask.2"], _relu(conv2d(up["mask.0"], net, 1, 1)), 1, 0)
     return _convex_upsample(coords1 - coords0, mask)

@@ -1,0 +1,107 @@
+"""Cost-volume correlation kernels: XLA formulation + hand-tiled Pallas kernel.
+
+The reference implements PWC's 81-tap correlation as four raw CUDA kernels
+JIT-compiled through CuPy (``/root/reference/models/pwc/pwc_src/correlation.py:17-242``).
+Semantics: pad fmap2 by 4 px, mean-over-channels dot product between each pixel
+of fmap1 and its 9×9 neighborhood in fmap2 → ``(B, H, W, 81)`` with channel
+``k = (dy+4)·9 + (dx+4)`` (``:79-81``; forward-only — inference framework).
+
+Two TPU implementations, selectable per call (``--pwc_corr``):
+
+- ``xla``: 81 shifted elementwise products + channel mean. XLA fuses the shifts
+  into a few HBM passes; this is the parity-proven default.
+- ``pallas``: one VMEM-resident tile per batch element — fmap1, the padded
+  fmap2, and all 81 output channels stay on-chip; the 9×9 window walk reads the
+  padded tile 81× from VMEM instead of HBM. Useful when the fused XLA schedule
+  spills (large C); falls back to ``xla`` when the working set exceeds VMEM.
+
+Both are exercised by tests/test_pallas_corr.py (Pallas in interpreter mode on
+CPU, compiled on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+CORR_RADIUS = 4
+CORR_CHANNELS = (2 * CORR_RADIUS + 1) ** 2  # 81
+
+# conservative per-core VMEM budget for the tile working set (bytes)
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def corr81_xla(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
+    """Channel-mean cost volume over the 9×9 displacement window (pure XLA)."""
+    b, h, w, c = f1.shape
+    r = CORR_RADIUS
+    f2p = jnp.pad(f2, ((0, 0), (r, r), (r, r), (0, 0)))
+    f1 = f1.astype(jnp.float32)
+    taps = []
+    for dy in range(-r, r + 1):
+        for dx in range(-r, r + 1):
+            shifted = f2p[:, r + dy : r + dy + h, r + dx : r + dx + w, :].astype(jnp.float32)
+            taps.append(jnp.mean(f1 * shifted, axis=-1))
+    return jnp.stack(taps, axis=-1)
+
+
+def _corr81_kernel(f1_ref, f2p_ref, out_ref):
+    """One batch element per grid step; everything VMEM-resident.
+
+    f1 (1, H, W, C), f2p (1, H+8, W+8, C) → out (1, H, W, 81). The 81 window
+    taps are unrolled statically; each is a VPU multiply + lane reduction.
+    """
+    f1 = f1_ref[0].astype(jnp.float32)
+    h, w, c = f1.shape
+    taps = []
+    for dy in range(2 * CORR_RADIUS + 1):
+        for dx in range(2 * CORR_RADIUS + 1):
+            shifted = f2p_ref[0, dy : dy + h, dx : dx + w, :].astype(jnp.float32)
+            taps.append(jnp.sum(f1 * shifted, axis=-1) * (1.0 / c))
+    out_ref[0] = jnp.stack(taps, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def corr81_pallas(f1: jnp.ndarray, f2: jnp.ndarray, interpret: bool = False) -> jnp.ndarray:
+    """Pallas tile kernel; grid over the batch axis.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter (CPU tests).
+    """
+    from jax.experimental import pallas as pl
+
+    b, h, w, c = f1.shape
+    r = CORR_RADIUS
+    f2p = jnp.pad(f2, ((0, 0), (r, r), (r, r), (0, 0)))
+    return pl.pallas_call(
+        _corr81_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, h, w, CORR_CHANNELS), jnp.float32),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((1, h + 2 * r, w + 2 * r, c), lambda i: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, w, CORR_CHANNELS), lambda i: (i, 0, 0, 0)),
+        interpret=interpret,
+    )(f1, f2p)
+
+
+def _fits_vmem(h: int, w: int, c: int) -> bool:
+    r = CORR_RADIUS
+    working = 4 * (h * w * c + (h + 2 * r) * (w + 2 * r) * c + h * w * CORR_CHANNELS)
+    return working <= _VMEM_BUDGET
+
+
+def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
+    """Dispatch: ``xla`` (default), ``pallas``, or ``pallas_interpret`` (tests)."""
+    if impl == "xla":
+        return corr81_xla(f1, f2)
+    _, h, w, c = f1.shape
+    if impl == "pallas_interpret":
+        return corr81_pallas(f1, f2, interpret=True)
+    if impl == "pallas":
+        if not _fits_vmem(h, w, c):
+            return corr81_xla(f1, f2)  # tile exceeds VMEM — fused XLA handles it
+        return corr81_pallas(f1, f2)
+    raise ValueError(f"unknown corr impl {impl!r}; expected xla|pallas|pallas_interpret")
