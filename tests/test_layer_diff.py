@@ -1,0 +1,28 @@
+"""Per-layer activation parity: every stage of I3D and RAFT must match the
+independent torch mirror at fp32 noise level (SURVEY.md §4's layer-diff plan).
+
+End-to-end parity can hide a topology error behind pooling; this localizes any
+divergence to the first wrong layer. Runs on CPU (conftest) — fp32 exact."""
+
+import pytest
+
+from tools.layer_diff import i3d_layer_diff, raft_layer_diff
+
+
+@pytest.mark.parametrize("modality", ["rgb", "flow"])
+def test_i3d_every_layer_matches(modality):
+    rows = i3d_layer_diff(modality, shape=(1, 16, 64, 64))
+    assert len(rows) == 12  # 4 stem convs/pools named + 9 mixed − pools untapped
+    for name, diff, scale in rows:
+        assert diff <= 1e-4 + 1e-5 * max(scale, 1.0), f"{name} diverges: {diff} (scale {scale})"
+
+
+def test_raft_every_stage_matches():
+    rows = raft_layer_diff(shape=(1, 128, 128), iters=4)
+    names = [r[0] for r in rows]
+    assert {"fnet1", "fnet2", "cnet", "corr_l0"} <= set(names)
+    assert sum(n.startswith("flow_iter") for n in names) == 4
+    for name, diff, scale in rows:
+        # recurrent iterations amplify fp noise ~2× per step; bound generously
+        tol = 1e-3 if name.startswith("flow_iter") else 1e-4
+        assert diff <= tol * max(scale, 1.0), f"{name} diverges: {diff} (scale {scale})"

@@ -78,14 +78,38 @@ class ResNet50(nn.Module):
 
 
 # ---------------------------------------------------------------------------
-# I3D: functional mirror (no nn.Module graph) driven by the SAME spec table as
-# the Flax model (imported, not copied). Consumes/produces reference-named
-# state_dicts (conv3d_1a_7x7.conv3d.weight, mixed_3b.branch_1.0..., ...).
+# I3D: functional mirror (no nn.Module graph). The layer table below is
+# transcribed INDEPENDENTLY from the reference source
+# (/root/reference/models/i3d/i3d_src/i3d_net.py:179-224) — deliberately NOT
+# imported from video_features_tpu.models.i3d, so a wrong channel count or
+# missing branch in the Flax spec table fails parity instead of propagating
+# into the oracle (tests/test_mirror_independence.py cross-checks the tables).
+# Consumes/produces reference-named state_dicts (conv3d_1a_7x7.conv3d.weight,
+# mixed_3b.branch_1.0..., ...).
 # ---------------------------------------------------------------------------
 
 import torch.nn.functional as F
 
-from video_features_tpu.models.i3d import I3D_STEM as I3D_LAYERS
+# (op, name, out_channels, kernel, stride) / (pool, name, kernel, stride) /
+# (mixed, name, (b0, b1_reduce, b1, b2_reduce, b2, b3)); i3d_net.py:179-224
+I3D_LAYERS = (
+    ("conv", "conv3d_1a_7x7", 64, (7, 7, 7), (2, 2, 2)),
+    ("pool", "maxPool3d_2a_3x3", (1, 3, 3), (1, 2, 2)),
+    ("conv", "conv3d_2b_1x1", 64, (1, 1, 1), (1, 1, 1)),
+    ("conv", "conv3d_2c_3x3", 192, (3, 3, 3), (1, 1, 1)),
+    ("pool", "maxPool3d_3a_3x3", (1, 3, 3), (1, 2, 2)),
+    ("mixed", "mixed_3b", (64, 96, 128, 16, 32, 32)),
+    ("mixed", "mixed_3c", (128, 128, 192, 32, 96, 64)),
+    ("pool", "maxPool3d_4a_3x3", (3, 3, 3), (2, 2, 2)),
+    ("mixed", "mixed_4b", (192, 96, 208, 16, 48, 64)),
+    ("mixed", "mixed_4c", (160, 112, 224, 24, 64, 64)),
+    ("mixed", "mixed_4d", (128, 128, 256, 24, 64, 64)),
+    ("mixed", "mixed_4e", (112, 144, 288, 32, 64, 64)),
+    ("mixed", "mixed_4f", (256, 160, 320, 32, 128, 128)),
+    ("pool", "maxPool3d_5a_2x2", (2, 2, 2), (2, 2, 2)),
+    ("mixed", "mixed_5b", (256, 160, 320, 32, 128, 128)),
+    ("mixed", "mixed_5c", (384, 192, 384, 48, 128, 128)),
+)
 
 
 def _tf_same_pad_5d(kernel, stride):
@@ -118,8 +142,11 @@ def _i3d_pool(x, kernel, stride):
     return F.max_pool3d(x, kernel, stride, ceil_mode=True)
 
 
-def i3d_forward(sd, x, features=True):
-    """Functional I3D on (B, C, T, H, W); mirrors i3d_net.py numerics for parity."""
+def i3d_forward(sd, x, features=True, taps=None):
+    """Functional I3D on (B, C, T, H, W); mirrors i3d_net.py numerics for parity.
+
+    ``taps``: debug-only dict filled with each named layer's output (NCTHW) for
+    the layer-diff parity harness (tools/layer_diff.py)."""
     with torch.no_grad():
         for layer in I3D_LAYERS:
             kind, name = layer[0], layer[1]
@@ -137,6 +164,8 @@ def i3d_forward(sd, x, features=True):
                                _i3d_unit(sd, f"{name}.branch_2.0", x), (3, 3, 3))
                 b3 = _i3d_unit(sd, f"{name}.branch_3.1", _i3d_pool(x, (3, 3, 3), (1, 1, 1)))
                 x = torch.cat([b0, b1, b2, b3], dim=1)
+            if taps is not None:
+                taps[name] = x
         # reference kernel (2,7,7) == (2, H, W) at the supported 224-crop geometry
         x = F.avg_pool3d(x, (2, x.shape[3], x.shape[4]), (1, 1, 1))
         if features:
@@ -182,12 +211,62 @@ def i3d_random_state_dict(modality="rgb", num_classes=400, seed=0):
 
 
 # ---------------------------------------------------------------------------
-# RAFT: functional torch mirror of the reference semantics (raft_src/), driven
-# by the SAME shape spec as the JAX model (imported). Parity oracle for
-# video_features_tpu.models.raft.
+# RAFT: functional torch mirror of the reference semantics (raft_src/). The
+# shape table is transcribed INDEPENDENTLY from the reference source — NOT
+# imported from video_features_tpu.models.raft — so the oracle cannot inherit
+# a Flax spec-table bug. Sources: BasicEncoder channels 64→64/96/128→out
+# (extractor.py:118-148), BasicMotionEncoder (update.py:83-91), SepConvGRU
+# (update.py:37-46), FlowHead (update.py:10-14), mask head (update.py:124-128),
+# RAFT dims hidden=context=128, corr 4 levels radius 4 (raft.py:55-67).
 # ---------------------------------------------------------------------------
 
-from video_features_tpu.models.raft import _conv_shapes as raft_conv_shapes
+
+def raft_conv_shapes():
+    """name → (cin, cout, kh, kw) conv / (c,) norm, reference state_dict names."""
+    shapes = {}
+
+    def encoder(prefix, out_dim, batch_norm):
+        # conv1: 3→64 k7 s2 (extractor.py:135); residual stages 64,96,128 of two
+        # blocks each, stride 2 on the first block of layer2/3 (:137-142)
+        shapes[f"{prefix}.conv1"] = (3, 64, 7, 7)
+        if batch_norm:
+            shapes[f"{prefix}.norm1"] = (64,)
+        cin = 64
+        for stage, dim, stride in (("layer1", 64, 1), ("layer2", 96, 2), ("layer3", 128, 2)):
+            for blk in (0, 1):
+                s = stride if blk == 0 else 1
+                p = f"{prefix}.{stage}.{blk}"
+                shapes[f"{p}.conv1"] = (cin if blk == 0 else dim, dim, 3, 3)
+                shapes[f"{p}.conv2"] = (dim, dim, 3, 3)
+                if batch_norm:
+                    shapes[f"{p}.norm1"] = (dim,)
+                    shapes[f"{p}.norm2"] = (dim,)
+                if blk == 0 and s != 1:
+                    shapes[f"{p}.downsample.0"] = (cin, dim, 1, 1)
+                    if batch_norm:
+                        shapes[f"{p}.norm3"] = (dim,)
+            cin = dim
+        shapes[f"{prefix}.conv2"] = (128, out_dim, 1, 1)  # extractor.py:144
+
+    encoder("fnet", 256, batch_norm=False)   # raft.py:129 output_dim=256, instance norm
+    encoder("cnet", 128 + 128, batch_norm=True)  # hdim+cdim (raft.py:58-59)
+
+    cor_planes = 4 * (2 * 4 + 1) ** 2  # levels × (2r+1)², update.py:85-86 → 324
+    ub = "update_block"
+    shapes[f"{ub}.encoder.convc1"] = (cor_planes, 256, 1, 1)  # update.py:87
+    shapes[f"{ub}.encoder.convc2"] = (256, 192, 3, 3)         # update.py:88
+    shapes[f"{ub}.encoder.convf1"] = (2, 128, 7, 7)           # update.py:89
+    shapes[f"{ub}.encoder.convf2"] = (128, 64, 3, 3)          # update.py:90
+    shapes[f"{ub}.encoder.conv"] = (64 + 192, 128 - 2, 3, 3)  # update.py:91
+    gru_in = 128 + (128 + 128)  # hidden + input_dim(128+hidden), update.py:37-38,122
+    for sfx, k in (("1", (1, 5)), ("2", (5, 1))):  # update.py:40-46
+        for gate in ("convz", "convr", "convq"):
+            shapes[f"{ub}.gru.{gate}{sfx}"] = (gru_in, 128, *k)
+    shapes[f"{ub}.flow_head.conv1"] = (128, 256, 3, 3)  # update.py:13 hidden=256
+    shapes[f"{ub}.flow_head.conv2"] = (256, 2, 3, 3)    # update.py:14
+    shapes[f"{ub}.mask.0"] = (128, 256, 3, 3)           # update.py:126
+    shapes[f"{ub}.mask.2"] = (256, 64 * 9, 1, 1)        # update.py:128
+    return shapes
 
 
 def raft_random_state_dict(seed: int = 0):
@@ -243,9 +322,11 @@ def _raft_bilinear(img, coords):
     return F.grid_sample(img, torch.stack([xg, yg], -1), align_corners=True)
 
 
-def raft_torch_forward(sd, image1, image2, iters=20):
+def raft_torch_forward(sd, image1, image2, iters=20, taps=None):
     """(B, 3, H, W) float RGB [0,255], H,W /8 → (B, 2, H, W) flow. Mirrors
-    raft.py:115-174 numerics including the delta-grid dx/dy swap (corr.py:37-43)."""
+    raft.py:115-174 numerics including the delta-grid dx/dy swap (corr.py:37-43).
+
+    ``taps``: debug-only dict of per-stage activations for tools/layer_diff.py."""
     with torch.no_grad():
         x1 = 2 * (image1 / 255.0) - 1.0
         x2 = 2 * (image2 / 255.0) - 1.0
@@ -262,6 +343,9 @@ def raft_torch_forward(sd, image1, image2, iters=20):
 
         cnet = _raft_encoder(sd, "cnet", x1, "batch")
         net, inp = torch.tanh(cnet[:, :128]), F.relu(cnet[:, 128:])
+        if taps is not None:
+            taps["fnet1"], taps["fnet2"], taps["cnet"] = f1, f2, cnet
+            taps["corr_l0"] = pyramid[0]
 
         ys, xs = torch.meshgrid(torch.arange(H), torch.arange(W), indexing="ij")
         coords0 = torch.stack([xs, ys], 0).float()[None].repeat(B, 1, 1, 1)
@@ -304,6 +388,10 @@ def raft_torch_forward(sd, image1, image2, iters=20):
             delta_flow = _rconv(sd, "update_block.flow_head.conv2",
                                 F.relu(_rconv(sd, "update_block.flow_head.conv1", net, 1, 1)), 1, 1)
             coords1 = coords1 + delta_flow
+            if taps is not None:
+                taps[f"flow_iter{len([k for k in taps if k.startswith('flow_iter')])}"] = (
+                    coords1 - coords0
+                )
 
         mask = 0.25 * _rconv(sd, "update_block.mask.2",
                              F.relu(_rconv(sd, "update_block.mask.0", net, 1, 1)))
@@ -317,12 +405,62 @@ def raft_torch_forward(sd, image1, image2, iters=20):
 
 
 # ---------------------------------------------------------------------------
-# PWC-Net: functional torch mirror of the reference semantics (pwc_src/), driven
-# by the SAME shape spec as the JAX model. torch-1.2 grid_sample semantics
+# PWC-Net: functional torch mirror of the reference semantics (pwc_src/). The
+# tables below are transcribed INDEPENDENTLY from pwc_net.py — NOT imported
+# from video_features_tpu.models.pwc. torch-1.2 grid_sample semantics
 # (align_corners=True) per the pinned conda_env_pwc.yml.
 # ---------------------------------------------------------------------------
 
-from video_features_tpu.models.pwc import DEC_BACKWARD, LEVEL_NAMES, pwc_conv_shapes
+# PWCNet decoder attribute per pyramid level (pwc_net.py:215-221)
+LEVEL_NAMES = {2: "moduleTwo", 3: "moduleThr", 4: "moduleFou", 5: "moduleFiv", 6: "moduleSix"}
+# dblBackward warp scaling, indexed by the level whose decoder consumes it
+# (pwc_net.py:124: [None,None,None,5.0,2.5,1.25,0.625,None][intLevel+1])
+DEC_BACKWARD = {2: 5.0, 3: 2.5, 4: 1.25, 5: 0.625}
+
+# Extractor per-level (out_channels) ×3 convs each (pwc_net.py:48-101)
+_PWC_EXTRACTOR_CH = (16, 32, 64, 96, 128, 196)
+# Decoder input width per level: 81 corr (+ feat + 2 flow + 2 upfeat below L6)
+# (pwc_net.py:120-121: intCurrent = [None,None,81+32+2+2,81+64+2+2,81+96+2+2,81+128+2+2,81,None])
+_PWC_DEC_CURRENT = {2: 81 + 32 + 4, 3: 81 + 64 + 4, 4: 81 + 96 + 4, 5: 81 + 128 + 4, 6: 81}
+# DenseNet decoder head widths (pwc_net.py:128-158)
+_PWC_DEC_OUT = (128, 128, 96, 64, 32)
+
+
+def pwc_conv_shapes():
+    """name → (cin, cout, kh, kw), 'T'-prefixed for ConvTranspose2d weights."""
+    shapes = {}
+    cin = 3
+    for name, cout in zip(
+        ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv", "moduleSix"),
+        _PWC_EXTRACTOR_CH,
+    ):
+        p = f"moduleExtractor.{name}"
+        shapes[f"{p}.0"] = (cin, cout, 3, 3)   # stride-2 conv (pwc_net.py:49)
+        shapes[f"{p}.2"] = (cout, cout, 3, 3)
+        shapes[f"{p}.4"] = (cout, cout, 3, 3)
+        cin = cout
+
+    for level in (6, 5, 4, 3, 2):
+        mod = LEVEL_NAMES[level]
+        cur = _PWC_DEC_CURRENT[level]
+        if level < 6:
+            prev = _PWC_DEC_CURRENT[level + 1]
+            # ConvTranspose2d weights are (cin, cout, kh, kw) (pwc_net.py:123-124)
+            shapes[f"{mod}.moduleUpflow"] = ("T", 2, 2, 4, 4)
+            shapes[f"{mod}.moduleUpfeat"] = ("T", prev + sum(_PWC_DEC_OUT), 2, 4, 4)
+        feat = cur
+        for name, cout in zip(("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv"),
+                              _PWC_DEC_OUT):
+            shapes[f"{mod}.{name}.0"] = (feat, cout, 3, 3)
+            feat += cout
+        shapes[f"{mod}.moduleSix.0"] = (feat, 2, 3, 3)
+
+    # Refiner: 7 dilated convs from the level-2 dense feature (pwc_net.py:193-210)
+    refiner_in = _PWC_DEC_CURRENT[2] + sum(_PWC_DEC_OUT)  # 565
+    chans = (refiner_in, 128, 128, 128, 96, 64, 32, 2)
+    for i, idx in enumerate(("0", "2", "4", "6", "8", "10", "12")):
+        shapes[f"moduleRefiner.moduleMain.{idx}"] = (chans[i], chans[i + 1], 3, 3)
+    return shapes
 
 
 def pwc_random_state_dict(seed: int = 0):
@@ -431,12 +569,47 @@ def pwc_torch_forward(sd, image1, image2):
 
 
 # ---------------------------------------------------------------------------
-# R(2+1)D-18: functional torch mirror (torchvision r2plus1d_18 numerics), driven
-# by the SAME shape spec as the Flax model.
+# R(2+1)D-18: functional torch mirror (torchvision r2plus1d_18 numerics). The
+# shape table is transcribed INDEPENDENTLY from torchvision's VideoResNet
+# (torchvision/models/video/resnet.py: Conv2Plus1D + BasicBlock + R2Plus1dStem;
+# the checkpoint the reference loads at extract_r21d.py:57) — NOT imported from
+# video_features_tpu.models.r21d.
 # ---------------------------------------------------------------------------
 
-from video_features_tpu.models.r21d import STAGE_CHANNELS as R21D_STAGES
-from video_features_tpu.models.r21d import r21d_conv_shapes
+
+def r21d_conv_shapes():
+    """name → torch-layout shapes: conv (O, I, kt, kh, kw), ('bn', C), fc (O, I).
+
+    torchvision computes midplanes ONCE per BasicBlock from (inplanes, planes)
+    and reuses it for conv1 and conv2 — so downsampling blocks have a conv2
+    midplanes smaller than midplanes(planes, planes) would give (e.g.
+    layer2.0.conv2.0.0 is 230-wide, not 288).
+    """
+    shapes = {
+        # R2Plus1dStem: (1,7,7)/(1,2,2) conv → BN → ReLU → (3,1,1) conv → BN → ReLU
+        "stem.0": (45, 3, 1, 7, 7), "stem.1": ("bn", 45),
+        "stem.3": (64, 45, 3, 1, 1), "stem.4": ("bn", 64),
+    }
+    cin = 64
+    for stage, cout in enumerate((64, 128, 256, 512), start=1):
+        for blk in range(2):
+            p = f"layer{stage}.{blk}"
+            block_in = cin if blk == 0 else cout
+            mid = (block_in * cout * 3 * 3 * 3) // (block_in * 3 * 3 + 3 * cout)
+            shapes[f"{p}.conv1.0.0"] = (mid, block_in, 1, 3, 3)
+            shapes[f"{p}.conv1.0.1"] = ("bn", mid)
+            shapes[f"{p}.conv1.0.3"] = (cout, mid, 3, 1, 1)
+            shapes[f"{p}.conv1.1"] = ("bn", cout)
+            shapes[f"{p}.conv2.0.0"] = (mid, cout, 1, 3, 3)
+            shapes[f"{p}.conv2.0.1"] = ("bn", mid)
+            shapes[f"{p}.conv2.0.3"] = (cout, mid, 3, 1, 1)
+            shapes[f"{p}.conv2.1"] = ("bn", cout)
+            if blk == 0 and stage > 1:
+                shapes[f"{p}.downsample.0"] = (cout, block_in, 1, 1, 1)
+                shapes[f"{p}.downsample.1"] = ("bn", cout)
+        cin = cout
+    shapes["fc"] = (400, 512)
+    return shapes
 
 
 def r21d_random_state_dict(seed: int = 0):
