@@ -1,0 +1,34 @@
+"""Split a video directory into N round-robin file lists for N independent jobs.
+
+Drop-in equivalent of the reference's helper (``/root/reference/gen_file_list.py:6-21``),
+same flags; delegates to :func:`video_features_tpu.io.filelist.write_shard_files`.
+On a multi-host TPU deployment the same round-robin split runs implicitly via
+``parallel.pipeline.shard_video_list`` — this script exists for the reference's
+explicit launch-N-processes workflow.
+
+    python gen_file_list.py -p ./videos -o ./file_lists -n 4
+"""
+
+import argparse
+import os
+
+from video_features_tpu.io.filelist import write_shard_files
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("-p", "--path", type=str, required=True,
+                        help="directory whose entries become the video list")
+    parser.add_argument("-o", "--output_path", type=str, default="./file_lists",
+                        help="directory for the shard .txt files")
+    parser.add_argument("-n", "--num_split", type=int, default=1)
+    args = parser.parse_args()
+
+    out_files = write_shard_files(args.path, args.output_path, args.num_split)
+    total = sum(1 for p in out_files for _ in open(p))
+    print(f"wrote {len(out_files)} shard lists covering {total} files under "
+          f"{os.path.abspath(args.output_path)}")
+
+
+if __name__ == "__main__":
+    main()

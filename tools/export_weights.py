@@ -1,0 +1,111 @@
+"""Convert reference checkpoints into the store's ``.npz`` format.
+
+One command per checkpoint the reference loads (SURVEY.md §2.1 #25):
+
+    python tools/export_weights.py --model i3d_rgb   --src i3d_rgb.pt
+    python tools/export_weights.py --model raft-sintel --src raft-sintel.pth
+    python tools/export_weights.py --model pwc-sintel  --src network-default.pytorch
+    python tools/export_weights.py --model r2plus1d_18 --src r2plus1d_18-91a641e6.pth
+    python tools/export_weights.py --model resnet50    --src resnet50-0676ba61.pth
+    python tools/export_weights.py --model vggish      --src vggish_model.ckpt
+    python tools/export_weights.py --model vggish      --src vggish_tf_vars.npz
+
+Output: ``<out_dir>/<model>.npz`` with flat ``a/b/c`` Flax param keys —
+resolvable by ``weights.store.resolve_params`` without torch/TF at runtime.
+
+VGGish: the reference restores a TF-slim checkpoint
+(``/root/reference/models/vggish/vggish_src/vggish_slim.py:102-129``). A ``.ckpt``
+needs tensorflow installed (reads variables via ``tf.train.load_checkpoint``);
+alternatively pass an ``.npz`` of raw TF variables (``vggish/conv1/weights`` →
+array), which needs no TF.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from video_features_tpu.weights.store import looks_like_tf_vars, save_params_npz  # noqa: E402
+
+TORCH_CONVERTERS = {
+    "resnet50": "convert_resnet50",
+    "r2plus1d_18": "convert_r21d",
+    "i3d_rgb": "convert_i3d",
+    "i3d_flow": "convert_i3d",
+    "raft-sintel": "convert_raft",
+    "raft-kitti": "convert_raft",
+    "pwc-sintel": "convert_pwc",
+}
+
+
+def _strip_module_prefix(sd: dict) -> dict:
+    """The reference wraps RAFT in DataParallel only to match 'module.'-prefixed
+    checkpoint keys (extract_raft.py:58-59); strip instead of wrapping."""
+    if sd and all(k.startswith("module.") for k in sd):
+        return {k[len("module."):]: v for k, v in sd.items()}
+    return sd
+
+
+def convert_torch_checkpoint(model: str, src: str) -> dict:
+    import torch
+
+    from video_features_tpu.weights import convert_torch as ct
+
+    sd = torch.load(src, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    sd = _strip_module_prefix(sd)
+    return getattr(ct, TORCH_CONVERTERS[model])(sd)
+
+
+def convert_vggish_checkpoint(src: str) -> dict:
+    from video_features_tpu.models.vggish import convert_tf_vggish
+
+    if src.endswith(".npz"):
+        with np.load(src) as z:
+            tf_vars = {k: z[k] for k in z.files}
+        if not looks_like_tf_vars(tf_vars):
+            raise ValueError(f"{src}: not a TF-variables npz (expected */weights, */biases)")
+        return convert_tf_vggish(tf_vars)
+    try:
+        import tensorflow as tf  # optional: only needed for raw .ckpt input
+    except ImportError as e:
+        raise SystemExit(
+            f"reading {src} requires tensorflow; alternatively dump the checkpoint "
+            "variables to an .npz (keys like 'vggish/conv1/weights') and pass that"
+        ) from e
+    reader = tf.train.load_checkpoint(src)
+    tf_vars = {
+        name: reader.get_tensor(name)
+        for name in reader.get_variable_to_shape_map()
+        if name.startswith("vggish/")
+    }
+    return convert_tf_vggish(tf_vars)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", required=True, choices=sorted([*TORCH_CONVERTERS, "vggish"]))
+    ap.add_argument("--src", required=True, help="torch .pt/.pth, TF .ckpt, or TF-vars .npz")
+    ap.add_argument("--out_dir", default="./checkpoints")
+    args = ap.parse_args()
+
+    if args.model == "vggish":
+        params = convert_vggish_checkpoint(args.src)
+    else:
+        params = convert_torch_checkpoint(args.model, args.src)
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    out = os.path.join(args.out_dir, f"{args.model}.npz")
+    save_params_npz(out, params)
+    n = sum(1 for _ in np.load(out).files)
+    print(f"wrote {out} ({n} arrays)")
+
+
+if __name__ == "__main__":
+    main()
