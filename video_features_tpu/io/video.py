@@ -66,31 +66,38 @@ def _raw_frames(cap: cv2.VideoCapture) -> Iterator[Tuple[np.ndarray, float]]:
         yield rgb, cap.get(cv2.CAP_PROP_POS_MSEC)
 
 
-def _resampled_frames(
-    cap: cv2.VideoCapture, src_fps: float, dst_fps: float
-) -> Iterator[Tuple[np.ndarray, float]]:
-    """Emulate ffmpeg's ``fps=dst_fps`` filter by timestamp-nearest frame selection.
+def resample_slots(src_index: int, src_fps: float, dst_fps: float) -> int:
+    """Output slot an input frame maps to under ffmpeg's ``fps=`` filter.
 
-    ffmpeg's fps filter emits one frame per output timestamp ``j / dst_fps``, choosing
-    the last input frame whose timestamp is <= the output timestamp (dropping or
-    duplicating as needed). We reproduce that selection on the decoded stream without
-    re-encoding.
+    ffmpeg (libavfilter/vf_fps.c, default ``round=near`` = AV_ROUND_NEAR_INF)
+    rescales each input pts into the output timebase rounding half away from
+    zero: frame at ``t = i/src`` → slot ``⌊t·dst + 0.5⌋``.
     """
-    out_idx = 0
+    return int(np.floor(src_index * dst_fps / src_fps + 0.5))
+
+
+def _resampled_frames(
+    frames: Iterator[Tuple[np.ndarray, float]], src_fps: float, dst_fps: float
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """Emulate ffmpeg's ``fps=dst_fps`` filter on a decoded stream (no re-encode).
+
+    Slot semantics (vf_fps.c): output slot ``j`` displays the LAST input frame
+    whose rounded output pts (:func:`resample_slots`) is ≤ ``j`` — later frames
+    mapping to an already-claimed slot replace nothing (dropped); gaps duplicate
+    the previous frame. Timestamps follow the decode path's ``CAP_PROP_POS_MSEC``
+    convention (timestamp *after* the frame): slot ``j`` → ``(j+1)/dst`` ms.
+    """
+    next_slot = 0
     prev: Optional[np.ndarray] = None
-    src_idx = -1
-    for rgb, _pos in _raw_frames(cap):
-        src_idx += 1
-        t_in = src_idx / src_fps
-        # emit all output frames whose timestamp falls strictly before this input frame
-        while (out_idx / dst_fps) < t_in - 1e-9:
-            frame = prev if prev is not None else rgb
-            out_idx += 1
-            yield frame.copy(), out_idx / dst_fps * 1000.0
-        prev = rgb
+    for src_idx, (rgb, _pos) in enumerate(frames):
+        slot = resample_slots(src_idx, src_fps, dst_fps)
+        # slots strictly before this frame's slot belong to the previous frame
+        while prev is not None and next_slot < slot:
+            yield prev.copy(), (next_slot + 1) / dst_fps * 1000.0
+            next_slot += 1
+        prev = rgb  # claims slot max(slot, next_slot) unless a later frame does
     if prev is not None:
-        out_idx += 1
-        yield prev.copy(), out_idx / dst_fps * 1000.0
+        yield prev.copy(), (next_slot + 1) / dst_fps * 1000.0
 
 
 def open_video(
@@ -149,7 +156,7 @@ def open_video(
     )
 
     if native_resample:
-        frames = _resampled_frames(cap, src_fps, float(extraction_fps))
+        frames = _resampled_frames(_raw_frames(cap), src_fps, float(extraction_fps))
     else:
         frames = _raw_frames(cap)
 
