@@ -1,64 +1,255 @@
-"""Throughput benchmark: frames/sec through the jitted ResNet-50 feature step.
+"""North-star throughput bench: clips/sec/chip for I3D-rgb (headline), I3D-flow(RAFT),
+RAFT dense flow, and ResNet-50 — through the REAL extractor device steps.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} (the headline
+I3D-rgb number, per BASELINE.json's metric); every measured config, achieved
+TFLOP/s (from XLA's compiled cost analysis), and fp32-vs-bf16 deltas are written to
+``bench_details.json``. ``vs_baseline`` compares against the torch reference
+computation measured on this host by ``tools/measure_reference.py``
+(BASELINE.json key ``measured.i3d_rgb_clips_per_sec``), else 0.0.
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` compares against
-a locally recorded reference-equivalent torch-CPU measurement when available
-(``BASELINE.json`` key ``measured.resnet50_fps``), else 0.0.
+Methodology (addresses the round-1 review): inputs VARY across iterations (4
+distinct random buffers cycled), every iteration's output is retained and synced
+at the end (nothing elided), timing is the median of 3 repeats after a compile +
+warmup pass, and FLOPs come from the compiled executable — not hand math.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
+import sys
 import time
 
 import numpy as np
 
 
-def main() -> None:
+def _log(msg: str) -> None:
+    print(f"[bench +{time.perf_counter() - _T0:8.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _flops_of(step, *args) -> float:
+    """Total FLOPs of one compiled step per XLA cost analysis (0.0 if unavailable)."""
+    try:
+        cost = step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def _force(outs) -> float:
+    """Force execution of every output with ONE host fetch.
+
+    Methodology note (round-2 finding): the axon tunnel backend memoizes
+    identical (executable, args) calls AND returns from ``block_until_ready``
+    without waiting, so naive timing measures dispatch, not compute. A scalar
+    that data-depends on every output leaf, fetched to host, cannot be faked.
+    """
     import jax
     import jax.numpy as jnp
 
-    from video_features_tpu.models.resnet import ResNet50, preprocess_frames
+    leaves = [l for l in jax.tree_util.tree_leaves(outs) if l is not None]
+    acc = None
+    for l in leaves:
+        v = l.ravel()[0].astype(jnp.float32)
+        acc = v if acc is None else acc + v
+    return float(acc)
 
-    batch, size = 64, 224
-    model = ResNet50()
-    params = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, size, size, 3)), features=False
-    )["params"]
 
-    @jax.jit
-    def step(params, frames_u8):
-        x = preprocess_frames(frames_u8)
-        return model.apply({"params": params}, x, features=True).astype(jnp.float32)
+def _time_step(step, make_inputs, iters: int, repeats: int = 3):
+    """Median seconds/iteration over ``repeats`` rounds of ``iters`` calls.
 
-    frames = jnp.asarray(
-        np.random.default_rng(0).integers(0, 256, (batch, size, size, 3), dtype=np.uint8)
+    ``make_inputs()`` must return FRESH input arrays every call (unique args
+    defeat the backend's result memoization); the per-round host-sync latency
+    is measured separately and subtracted. Returns (sec_per_iter, sync_sec).
+    """
+    warm = step(*make_inputs())
+    _force(warm)  # compile + first execution
+    # tunnel host-sync latency baseline (median of 3)
+    sync = statistics.median(
+        [_timeit(lambda: _force(warm)) for _ in range(3)]
     )
-    step(params, frames).block_until_ready()  # compile
+    times = []
+    for _ in range(repeats):
+        ins = [make_inputs() for _ in range(iters)]  # built outside the clock
+        _force([t[1:] for t in ins])  # input transfers completed pre-clock
+        t0 = time.perf_counter()
+        outs = [step(*ins[i]) for i in range(iters)]
+        _force(outs)
+        times.append(max(time.perf_counter() - t0 - sync, 1e-9) / iters)
+    return statistics.median(times), sync
 
-    n_iters = 10
+
+def _timeit(fn) -> float:
     t0 = time.perf_counter()
-    for _ in range(n_iters):
-        out = step(params, frames)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-    fps = batch * n_iters / dt
+    fn()
+    return time.perf_counter() - t0
 
-    baseline = 0.0
+
+def _repeats(on_cpu: bool) -> int:
+    return 1 if on_cpu else 3  # 1-core CPU smoke run vs real measurement
+
+
+def main() -> None:
+    import jax
+
+    # the image's sitecustomize pins the axon TPU platform; honor an explicit
+    # JAX_PLATFORMS=cpu (CPU smoke run) the way main.py does
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+    # persistent compilation cache: TPU compiles go over the tunnel and dominate
+    # bench wall time; cache them so reruns (and the driver's run) skip straight
+    # to execution
     try:
-        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
-            baseline = float(json.load(f).get("measured", {}).get("resnet50_fps", 0.0))
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.extractors.flow import ExtractFlow
+    from video_features_tpu.extractors.i3d import ExtractI3D
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+
+    on_cpu = jax.default_backend() == "cpu"
+    n_chips = jax.local_device_count()  # extractors mesh over all local devices
+    rng = np.random.default_rng(0)
+    details = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+    peak_tflops = float(os.environ.get("VFT_PEAK_TFLOPS", 0)) or None
+
+    def cfg(feature_type, **kw):
+        return ExtractionConfig(
+            feature_type=feature_type,
+            output_path=os.path.join("/tmp/vft_bench", "out"),
+            tmp_path=os.path.join("/tmp/vft_bench", "tmp"),
+            **kw,
+        )
+
+    def record(name, timing, units_per_iter, unit, flops_per_iter):
+        secs_per_iter, sync = timing
+        tflops = flops_per_iter / secs_per_iter / 1e12 if flops_per_iter else None
+        entry = {
+            "value": round(units_per_iter / secs_per_iter / n_chips, 3),
+            "unit": unit,
+            "sec_per_iter": round(secs_per_iter, 5),
+            "host_sync_sec": round(sync, 4),
+            "achieved_tflops_per_sec": round(tflops, 2) if tflops else None,
+        }
+        if tflops and peak_tflops:
+            entry["mfu_vs_peak"] = round(tflops / peak_tflops, 4)
+        details[name] = entry
+        _log(f"{name}: {entry['value']} {unit} "
+             f"({entry['sec_per_iter']}s/iter, {entry['achieved_tflops_per_sec']} TFLOP/s, "
+             f"sync {sync * 1e3:.0f}ms)")
+        return entry
+
+    # ---- I3D-rgb (headline): clips/sec/chip, 64-frame 256→224 stacks ----------
+    clips = int(os.environ.get("VFT_BENCH_CLIPS", 1 if on_cpu else 4))
+    stack = 16 if on_cpu else 64  # CPU smoke run shrinks the clip, same code path
+    iters = 2 if on_cpu else 8
+    headline = None
+    for dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
+        ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
+                            step_size=stack, clips_per_batch=clips, dtype=dtype))
+        _log(f"i3d_rgb_{dtype}: built extractor "
+             f"({ex.clips_per_batch} clips × {stack + 1} frames × 256², mesh-rounded)")
+
+        def mk(ex=ex):
+            return (ex.i3d_params["rgb"],
+                    ex.runner.put(rng.integers(0, 256,
+                                               (ex.clips_per_batch, stack + 1, 256, 256, 3),
+                                               dtype=np.uint8)))
+
+        _log(f"i3d_rgb_{dtype}: compiling + timing")
+        timing = _time_step(ex._rgb_step, mk, iters, _repeats(on_cpu))
+        e = record(f"i3d_rgb_{dtype}", timing, ex.clips_per_batch * stack / 64.0,
+                   "clips/sec/chip", _flops_of(ex._rgb_step, *mk()))
+        if dtype == "float32":
+            headline = e
+
+    # ---- I3D-flow with RAFT (north-star composite: flow net + I3D in one step) -
+    if not on_cpu:
+        _log("i3d_flow_raft: building extractor + inputs")
+        ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type="raft",
+                            stack_size=64, step_size=64, clips_per_batch=1))
+
+        def mk_flow(ex=ex):
+            return (ex.i3d_params["flow"],
+                    ex.runner.put(rng.integers(0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
+                                               dtype=np.uint8)))
+
+        timing = _time_step(ex._flow_step, mk_flow, iters=4)
+        record("i3d_flow_raft_float32", timing, ex.clips_per_batch, "clips/sec/chip",
+               _flops_of(ex._flow_step, *mk_flow()))
+
+    # ---- RAFT dense flow: pairs/sec at 256² (20 GRU iterations) ---------------
+    pairs, side = (1, 128) if on_cpu else (16, 256)
+    _log(f"raft_pairs: building extractor + inputs ({pairs} pairs × {side}²)")
+    ex = ExtractFlow(cfg("raft", batch_size=pairs))
+
+    def mk_pairs(ex=ex):
+        fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
+        return (ex.params, ex.runner.put(fr[:-1]), ex.runner.put(fr[1:]))
+
+    timing = _time_step(ex._step, mk_pairs, iters=1 if on_cpu else 6,
+                        repeats=_repeats(on_cpu))
+    record("raft_pairs_float32", timing, ex.batch_size, "pairs/sec/chip",
+           _flops_of(ex._step, *mk_pairs()))
+
+    # ---- ResNet-50 frames/sec (round-1 metric, kept for continuity) -----------
+    batch = 4 if on_cpu else 64
+    for dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
+        _log(f"resnet50_{dtype}: building extractor + inputs")
+        ex = ExtractResNet50(cfg("resnet50", batch_size=batch, dtype=dtype))
+
+        def mk_frames(ex=ex):
+            return (ex.params,
+                    ex.runner.put(rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
+                                               dtype=np.uint8)))
+
+        timing = _time_step(ex._step, mk_frames, iters=2 if on_cpu else 16,
+                            repeats=_repeats(on_cpu))
+        record(f"resnet50_{dtype}", timing, ex.batch_size, "frames/sec/chip",
+               _flops_of(ex._step, *mk_frames()))
+
+    # ---- headline line --------------------------------------------------------
+    baseline = 0.0
+    try:
+        with open(os.path.join(REPO, "BASELINE.json")) as f:
+            measured = json.load(f).get("measured", {})
+        baseline = float(measured.get("i3d_rgb_clips_per_sec", 0.0))
+        details["reference_measured"] = measured
+    except Exception:
+        pass
+
+    with open(os.path.join(REPO, "bench_details.json"), "w") as f:
+        json.dump(details, f, indent=2)
+
+    value = headline["value"]
     print(
         json.dumps(
             {
-                "metric": "resnet50_features_throughput",
-                "value": round(fps, 2),
-                "unit": "frames/sec",
-                "vs_baseline": round(fps / baseline, 3) if baseline else 0.0,
+                "metric": "i3d_rgb_clips_per_sec_per_chip",
+                "value": value,
+                "unit": "clips/sec/chip (64-frame 224² stacks)",
+                "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
             }
         )
     )
