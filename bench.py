@@ -213,6 +213,25 @@ def main() -> None:
     record("raft_pairs_float32", timing, ex.batch_size, "pairs/sec/chip",
            _flops_of(ex._step, *mk_pairs()))
 
+    # ---- PWC dense flow: pairs/sec at 256², xla vs pallas cost volume ---------
+    # the pallas kernel's VMEM working set caps its batch (ops/pallas_corr);
+    # the xla config is also run at the small batch for a like-for-like delta
+    pwc_configs = [("xla", pairs)]
+    if not on_cpu:
+        pwc_configs += [("xla", 2), ("pallas", 2)]
+    for corr, b in pwc_configs:
+        _log(f"pwc_pairs_{corr}_b{b}: building extractor + inputs ({b} pairs × {side}²)")
+        ex = ExtractFlow(cfg("pwc", batch_size=b, pwc_corr=corr))
+
+        def mk_pwc(ex=ex):
+            fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
+            return (ex.params, ex.runner.put(fr[:-1]), ex.runner.put(fr[1:]))
+
+        timing = _time_step(ex._step, mk_pwc, iters=1 if on_cpu else 6,
+                            repeats=_repeats(on_cpu))
+        record(f"pwc_pairs_float32_{corr}_b{b}", timing, ex.batch_size, "pairs/sec/chip",
+               _flops_of(ex._step, *mk_pwc()))
+
     # ---- ResNet-50 frames/sec (round-1 metric, kept for continuity) -----------
     batch = 4 if on_cpu else 64
     for dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):

@@ -117,6 +117,21 @@ def test_i3d_rgb_sharded_matches_single(tmp_path, rng):
     np.testing.assert_allclose(f4, f1, rtol=1e-4, atol=1e-4)
 
 
+def test_matmul_precision_plumbs(tmp_path, rng):
+    """--matmul_precision traces and matches default numerics on CPU (where
+    fp32 is already exact; on TPU 'highest' switches off the bf16 MXU passes)."""
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+
+    frames = rng.integers(0, 256, (8, 64, 64, 3), dtype=np.uint8)
+    ex_d = ExtractResNet50(_cfg(tmp_path, "resnet50", 1, batch_size=8))
+    ex_h = ExtractResNet50(
+        _cfg(tmp_path / "h", "resnet50", 1, batch_size=8, matmul_precision="highest")
+    )
+    f_d = np.asarray(ex_d._step(ex_d.params, ex_d.runner.put(frames)))
+    f_h = np.asarray(ex_h._step(ex_h.params, ex_h.runner.put(frames)))
+    np.testing.assert_allclose(f_h, f_d, rtol=1e-5, atol=1e-5 * np.abs(f_d).max())
+
+
 def test_raft_extract_end_to_end_sharded(tmp_path, sample_video):
     """Full extract() pipeline (decode → pairs → sharded RAFT → unpad → collect)
     gives identical flow on 1- and 8-device meshes."""

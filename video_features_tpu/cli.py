@@ -67,6 +67,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")
+    parser.add_argument("--matmul_precision", default=None,
+                        choices=["default", "high", "highest"],
+                        help="TPU fp32 matmul/conv precision; 'highest' for "
+                             "bit-parity with the torch reference")
     return parser
 
 

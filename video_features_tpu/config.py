@@ -67,6 +67,9 @@ class ExtractionConfig:
     # (decode vs device_wait vs overlapped time). VFT_METRICS=1 enables the
     # report without tracing.
     profile_dir: Optional[str] = None
+    # TPU fp32 convs default to bf16 MXU passes; "highest" gives true-fp32
+    # accumulation for the bit-parity path (None = XLA default).
+    matmul_precision: Optional[str] = None
 
     def validate(self) -> None:
         """Mirror the reference ``sanity_check`` (``utils/utils.py:88-105``)."""
@@ -98,6 +101,8 @@ class ExtractionConfig:
             raise ValueError("raft_corr must be 'volume' or 'on_demand'")
         if self.pwc_corr not in ("xla", "pallas"):
             raise ValueError("pwc_corr must be 'xla' or 'pallas'")
+        if self.matmul_precision not in (None, "default", "high", "highest"):
+            raise ValueError("matmul_precision must be default|high|highest")
 
     def replace(self, **kw) -> "ExtractionConfig":
         return dataclasses.replace(self, **kw)

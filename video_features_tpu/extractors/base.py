@@ -41,7 +41,7 @@ class Extractor(abc.ABC):
         # data-parallel mesh every device step runs on; --num_devices selects the
         # mesh size (None = all local devices), replacing the reference's
         # thread-per-GPU dispatch (/root/reference/main.py:37-47)
-        self.runner = MeshRunner(cfg.num_devices)
+        self.runner = MeshRunner(cfg.num_devices, cfg.matmul_precision)
         # per-video stage clock; active only when metrics are enabled (run())
         self.clock: Optional[StageClock] = None
 

@@ -100,14 +100,55 @@ def _delta_grid() -> jnp.ndarray:
 
 def _lookup(pyramid, coords: jnp.ndarray) -> jnp.ndarray:
     """9×9 bilinear window per level around the current correspondence,
-    flattened i-major into 81 channels per level."""
+    flattened i-major (δx-major) into 81 channels per level.
+
+    TPU formulation: every window point shares the query's fractional offset
+    (the 81 deltas are integers), so instead of 4 corner-gathers of 81 points
+    (gathers dominate RAFT runtime on TPU — measured ~56 ms/iteration), gather
+    ONE 10×10 integer patch per query and form all 81 bilinear values as four
+    shifted elementwise combinations of the patch. Identical arithmetic to
+    per-point bilinear sampling (same 4 products + 3 adds per value), ~3×
+    fewer gathered bytes and 4× fewer gather ops per level.
+    """
     b, h, w, _ = coords.shape
-    delta = _delta_grid()
+    r = CORR_RADIUS
+    n = b * h * w
+    win = 2 * r + 2  # 10: integer offsets −4…+5 cover all 81 corners
+    off = jnp.arange(-r, r + 2, dtype=jnp.int32)  # (10,)
     out = []
     for i, corr in enumerate(pyramid):
-        centroid = (coords / 2**i).reshape(b * h * w, 1, 1, 2)
-        sampled = bilinear_sample(corr, centroid + delta)  # (BHW, 9, 9, 1)
-        out.append(sampled.reshape(b, h, w, (2 * CORR_RADIUS + 1) ** 2))
+        hi, wi = corr.shape[1], corr.shape[2]
+        if hi == 0 or wi == 0:
+            # tiny inputs can pool a pyramid level away entirely; every tap is
+            # out of bounds → zeros (the per-corner mask semantics)
+            out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), jnp.float32))
+            continue
+        c = (coords / 2**i).reshape(n, 2)
+        cf = jnp.floor(c)
+        fx = (c[:, 0] - cf[:, 0])[:, None, None]  # (N, 1, 1)
+        fy = (c[:, 1] - cf[:, 1])[:, None, None]
+        ix = cf[:, 0].astype(jnp.int32)[:, None] + off[None, :]  # (N, 10) x taps
+        iy = cf[:, 1].astype(jnp.int32)[:, None] + off[None, :]  # (N, 10) y taps
+        # zero padding: out-of-bounds integer taps contribute 0 (grid_sample
+        # padding_mode='zeros' semantics, per corner tap)
+        mx = (ix >= 0) & (ix <= wi - 1)
+        my = (iy >= 0) & (iy <= hi - 1)
+        ixc = jnp.clip(ix, 0, wi - 1)
+        iyc = jnp.clip(iy, 0, hi - 1)
+        # per-image indices (a global arange(n)·hi·wi base overflows int32 for
+        # large frames × batch; per-image offsets are bounded by hi·wi)
+        idx = (iyc[:, :, None] * wi + ixc[:, None, :]).reshape(n, win * win)
+        patch = jnp.take_along_axis(corr.reshape(n, hi * wi), idx, axis=1)
+        patch = patch.reshape(n, win, win)  # ONE gather per level
+        patch = patch * (my[:, :, None] & mx[:, None, :]).astype(patch.dtype)
+        v = (
+            (1 - fy) * (1 - fx) * patch[:, : win - 1, : win - 1]
+            + (1 - fy) * fx * patch[:, : win - 1, 1:]
+            + fy * (1 - fx) * patch[:, 1:, : win - 1]
+            + fy * fx * patch[:, 1:, 1:]
+        )  # (N, 9y, 9x) window values
+        # channel order k = i·9 + j with (δ_i in x, δ_j in y): x-major flatten
+        out.append(v.transpose(0, 2, 1).reshape(b, h, w, (2 * r + 1) ** 2))
     return jnp.concatenate(out, axis=-1)  # (B, H, W, 4·81)
 
 

@@ -12,8 +12,9 @@ Two TPU implementations, selectable per call (``--pwc_corr``):
   into a few HBM passes; this is the parity-proven default.
 - ``pallas``: one VMEM-resident tile per batch element — fmap1, the padded
   fmap2, and all 81 output channels stay on-chip; the 9×9 window walk reads the
-  padded tile 81× from VMEM instead of HBM. Useful when the fused XLA schedule
-  spills (large C); falls back to ``xla`` when the working set exceeds VMEM.
+  padded tile 81× from VMEM instead of HBM. Statically dispatched per shape:
+  tiles outside the supported range (see ``_pallas_supported``) fall back to
+  ``xla``, so one PWC forward mixes kernel levels and XLA levels.
 
 Both are exercised by tests/test_pallas_corr.py (Pallas in interpreter mode on
 CPU, compiled on TPU).
@@ -87,21 +88,35 @@ def corr81_pallas(f1: jnp.ndarray, f2: jnp.ndarray, interpret: bool = False) -> 
     )(f1, f2p)
 
 
-def _fits_vmem(h: int, w: int, c: int) -> bool:
+def _pallas_supported(b: int, h: int, w: int, c: int) -> bool:
+    """Shape gate for the compiled kernel on the axon v5e backend (observed):
+
+    - XLA's memory-space assignment keeps the pallas call's full operands +
+      output in VMEM with double buffering, so the budget must cover
+      B × (f1 + padded f2 + out) × 2;
+    - tiles larger than 16×16 crash the backend's Mosaic compile subprocess
+      (HTTP 500 from tpu_compile_helper); ≤16² compiles and is bit-exact.
+
+    PWC's coarse pyramid levels (4²–16² at a 256² input) take the kernel;
+    finer levels fall back to the fused XLA formulation — dispatch is static
+    per call site, so a single forward mixes both.
+    """
+    if h > 16 or w > 16:
+        return False
     r = CORR_RADIUS
-    working = 4 * (h * w * c + (h + 2 * r) * (w + 2 * r) * c + h * w * CORR_CHANNELS)
-    return working <= _VMEM_BUDGET
+    per_elem = 4 * (h * w * c + (h + 2 * r) * (w + 2 * r) * c + h * w * CORR_CHANNELS)
+    return 2 * b * per_elem <= _VMEM_BUDGET
 
 
 def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
     """Dispatch: ``xla`` (default), ``pallas``, or ``pallas_interpret`` (tests)."""
     if impl == "xla":
         return corr81_xla(f1, f2)
-    _, h, w, c = f1.shape
+    b, h, w, c = f1.shape
     if impl == "pallas_interpret":
         return corr81_pallas(f1, f2, interpret=True)
     if impl == "pallas":
-        if not _fits_vmem(h, w, c):
-            return corr81_xla(f1, f2)  # tile exceeds VMEM — fused XLA handles it
+        if not _pallas_supported(b, h, w, c):
+            return corr81_xla(f1, f2)  # unsupported tile — fused XLA handles it
         return corr81_pallas(f1, f2)
     raise ValueError(f"unknown corr impl {impl!r}; expected xla|pallas|pallas_interpret")

@@ -55,7 +55,8 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1):
+def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
+                  matmul_precision: Optional[str] = None):
     """jit ``fn(params, *batches)`` with params replicated and batches sharded on axis 0.
 
     Each batch argument's leading axis must be divisible by the mesh size — callers
@@ -64,7 +65,18 @@ def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1):
     are left to XLA (batch-preserving steps keep rows sharded; ``np.asarray``
     gathers them to host). Inputs are not donated: the uint8→float first op can't
     reuse the input buffer anyway (XLA donation warning observed in round 1).
+
+    ``matmul_precision``: TPU fp32 convs/matmuls default to bf16 MXU passes;
+    ``"highest"`` traces the step under true-fp32 accumulation for the
+    bit-parity path (≈3× the matmul cost; irrelevant on CPU).
     """
+    if matmul_precision is not None:
+        inner = fn
+
+        def fn(*args):  # noqa: F811 — precision must be active at trace time
+            with jax.default_matmul_precision(matmul_precision):
+                return inner(*args)
+
     in_shardings = (replicate(mesh),) + (batch_sharding(mesh),) * n_batch_args
     return jax.jit(fn, in_shardings=in_shardings)
 
@@ -79,18 +91,20 @@ class MeshRunner:
     a sharded batch.
     """
 
-    def __init__(self, num_devices: Optional[int] = None):
+    def __init__(self, num_devices: Optional[int] = None,
+                 matmul_precision: Optional[str] = None):
         self.mesh = local_mesh(num_devices)
         self.num_devices = int(self.mesh.devices.size)
         self.batch_sharding = batch_sharding(self.mesh)
         self.replicated = replicate(self.mesh)
+        self.matmul_precision = matmul_precision
 
     def device_batch(self, requested: int) -> int:
         """Smallest multiple of the mesh size ≥ ``requested``."""
         return -(-requested // self.num_devices) * self.num_devices
 
     def jit(self, fn: Callable, n_batch_args: int = 1):
-        return sharded_apply(self.mesh, fn, n_batch_args)
+        return sharded_apply(self.mesh, fn, n_batch_args, self.matmul_precision)
 
     def put(self, arr):
         """Transfer a host batch onto the mesh, sharded along axis 0."""
