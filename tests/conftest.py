@@ -28,8 +28,10 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import pytest
 
-SAMPLE_VIDEO = "/root/reference/sample/v_GGSY1Qvo990.mp4"
-SAMPLE_VIDEO_2 = "/root/reference/sample/v_ZNVhz7ctTq0.mp4"
+_REPO_SAMPLE = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "sample")
+_SAMPLE_DIR = _REPO_SAMPLE if os.path.isdir(_REPO_SAMPLE) else "/root/reference/sample"
+SAMPLE_VIDEO = os.path.join(_SAMPLE_DIR, "v_GGSY1Qvo990.mp4")
+SAMPLE_VIDEO_2 = os.path.join(_SAMPLE_DIR, "v_ZNVhz7ctTq0.mp4")
 
 
 @pytest.fixture(scope="session")
