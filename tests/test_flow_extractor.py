@@ -86,3 +86,23 @@ def test_flow_viz_wheel():
     assert img.shape == (4, 5, 3) and img.dtype == np.uint8
     # pure rightward flow → angle π → single uniform color
     assert (img == img[0, 0]).all()
+
+
+def test_raft_on_demand_corr_through_extractor(tmp_path):
+    """--raft_corr on_demand plumbs through ExtractFlow and matches the volume
+    path (same numerics up to fp reduction order, amplified by 20 iterations)."""
+    mp = pytest.MonkeyPatch()
+    mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    try:
+        rng = np.random.default_rng(2)
+        frames = rng.uniform(0, 255, (5, 64, 72, 3)).astype(np.float32)
+        kw = dict(feature_type="raft", batch_size=4, output_path=str(tmp_path / "o"),
+                  tmp_path=str(tmp_path / "t"), num_devices=1)
+        vol = ExtractFlow(ExtractionConfig(**kw))
+        ond = ExtractFlow(ExtractionConfig(raft_corr="on_demand", **kw))
+        f_vol = vol._run_pairs(frames)
+        f_ond = ond._run_pairs(frames)
+        assert f_ond.shape == f_vol.shape == (4, 2, 64, 72)
+        np.testing.assert_allclose(f_ond, f_vol, rtol=5e-2, atol=5e-2)
+    finally:
+        mp.undo()

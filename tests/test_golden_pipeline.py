@@ -1,0 +1,90 @@
+"""Golden end-to-end parity: real decoded video frames through the production
+extractor step must match the torch mirror given identical converted weights.
+
+Closes the loop SURVEY.md §4 asks for: the per-model parity tests feed random
+arrays; these feed REAL frames through the host transform chain (native decode
+→ PIL resize → crop) and compare the full device step — so a host/device
+preprocessing drift (resize semantics, layout, normalization) fails here even
+when the network-only tests pass."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.io.video import open_video
+
+
+@pytest.fixture
+def ckpt_dir(tmp_path, monkeypatch):
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    monkeypatch.setenv("VFT_CHECKPOINT_DIR", str(d))
+    monkeypatch.delenv("VFT_ALLOW_RANDOM_WEIGHTS", raising=False)
+    return d
+
+
+def _cfg(tmp_path, **kw):
+    return ExtractionConfig(
+        output_path=str(tmp_path / "o"), tmp_path=str(tmp_path / "t"),
+        num_devices=1, **kw,
+    )
+
+
+def test_resnet_real_frames_match_torch(ckpt_dir, tmp_path, sample_video):
+    import torch
+
+    from tools.torch_mirrors import ResNet50 as TorchResNet50, random_init_
+
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+    from video_features_tpu.models.resnet import IMAGENET_MEAN, IMAGENET_STD
+
+    tm = random_init_(TorchResNet50(), seed=4)
+    torch.save(tm.state_dict(), ckpt_dir / "resnet50.pt")
+    ex = ExtractResNet50(_cfg(tmp_path, feature_type="resnet50", batch_size=8))
+
+    _, frames_iter = open_video(sample_video, transform=ex._host_transform)
+    frames = np.stack([rgb for rgb, _ in itertools.islice(frames_iter, 8)])
+    assert frames.shape == (8, 224, 224, 3) and frames.dtype == np.uint8
+
+    ours = np.asarray(ex._step(ex.params, ex.runner.put(frames)))
+
+    x = frames.astype(np.float32) / 255.0
+    x = ((x - np.asarray(IMAGENET_MEAN)) / np.asarray(IMAGENET_STD)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(x.transpose(0, 3, 1, 2)), features=True).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4 * np.abs(ref).max())
+
+
+def test_i3d_real_stack_matches_torch(ckpt_dir, tmp_path, sample_video):
+    import torch
+
+    from tools.torch_mirrors import i3d_forward, i3d_random_state_dict
+
+    from video_features_tpu.extractors.i3d import ExtractI3D
+    from video_features_tpu.ops.image import pil_edge_resize
+
+    sd = i3d_random_state_dict("rgb", seed=6)
+    torch.save(sd, ckpt_dir / "i3d_rgb.pt")
+    ex = ExtractI3D(_cfg(tmp_path, feature_type="i3d", streams=("rgb",),
+                         stack_size=16, step_size=16))
+
+    _, frames_iter = open_video(
+        sample_video, transform=lambda rgb: pil_edge_resize(rgb, 256)
+    )
+    stack = np.stack([rgb for rgb, _ in itertools.islice(frames_iter, 17)])
+    assert stack.shape[0] == 17
+
+    feats, _ = ex._rgb_step(ex.i3d_params["rgb"], ex.runner.put(stack[None]))
+    ours = np.asarray(feats)
+
+    # torch path: the reference transform chain on the same decoded frames —
+    # drop last frame, center-crop 224 (floor offsets), scale to [-1, 1], NCTHW
+    h, w = stack.shape[1:3]
+    fh, fw = (h - 224) // 2, (w - 224) // 2
+    crop = stack[:-1, fh : fh + 224, fw : fw + 224, :]
+    x = 2.0 * crop.astype(np.float32) / 255.0 - 1.0
+    xt = torch.from_numpy(x.transpose(3, 0, 1, 2)[None])  # (1, C, T, H, W)
+    ref = i3d_forward(sd, xt, features=True).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4 * np.abs(ref).max())

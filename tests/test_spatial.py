@@ -1,0 +1,61 @@
+"""Spatially-sharded convolution == unsharded SAME conv, on the 8-device mesh.
+
+The halo exchange (ppermute over ICI in production; the virtual CPU mesh here)
+must be numerically invisible: zero-pad boundaries, neighbor rows in between.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from video_features_tpu.parallel import local_mesh
+from video_features_tpu.parallel.spatial import sharded_conv_stack, sharded_same_conv2d
+
+
+def _ref_conv(x, k):
+    return lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(k), (1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@pytest.mark.parametrize("kh,kw", [(3, 3), (5, 3), (1, 1), (7, 5)])
+def test_sharded_conv_matches_unsharded(rng, kh, kw):
+    mesh = local_mesh(8)
+    x = rng.standard_normal((2, 64, 16, 8)).astype(np.float32)
+    k = rng.standard_normal((kh, kw, 8, 4)).astype(np.float32) * 0.1
+    ref = np.asarray(_ref_conv(x, k))
+    out = np.asarray(sharded_same_conv2d(mesh, jnp.asarray(x), jnp.asarray(k)))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_sharded_conv_stack_stays_sharded(rng):
+    mesh = local_mesh(8)
+    x = rng.standard_normal((1, 64, 16, 8)).astype(np.float32)
+    ks = [rng.standard_normal((3, 3, 8, 8)).astype(np.float32) * 0.1 for _ in range(3)]
+    out = sharded_conv_stack(mesh, jnp.asarray(x), [jnp.asarray(k) for k in ks])
+    # reference: plain chain
+    ref = jnp.asarray(x)
+    for k in ks:
+        ref = jnp.maximum(_ref_conv(ref, jnp.asarray(k)), 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    # activations really are H-sharded across all 8 devices
+    assert len(out.sharding.device_set) == 8
+
+
+def test_sharded_conv_rejects_thin_shards(rng):
+    mesh = local_mesh(8)
+    x = jnp.asarray(rng.standard_normal((1, 16, 8, 4)).astype(np.float32))  # 2 rows/dev
+    k = jnp.asarray(rng.standard_normal((7, 3, 4, 4)).astype(np.float32))  # halo 3
+    with pytest.raises(ValueError, match="halo"):
+        sharded_same_conv2d(mesh, x, k)
+
+
+def test_single_device_mesh_degenerates_to_plain_conv(rng):
+    mesh = local_mesh(1)
+    x = rng.standard_normal((1, 12, 10, 3)).astype(np.float32)
+    k = rng.standard_normal((3, 3, 3, 2)).astype(np.float32)
+    out = np.asarray(sharded_same_conv2d(mesh, jnp.asarray(x), jnp.asarray(k)))
+    np.testing.assert_allclose(out, np.asarray(_ref_conv(x, k)), rtol=1e-5, atol=1e-6)

@@ -116,7 +116,9 @@ def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
     if impl == "pallas_interpret":
         return corr81_pallas(f1, f2, interpret=True)
     if impl == "pallas":
-        if not _pallas_supported(b, h, w, c):
-            return corr81_xla(f1, f2)  # unsupported tile — fused XLA handles it
+        if jax.default_backend() != "tpu" or not _pallas_supported(b, h, w, c):
+            # Mosaic compiles TPU-only (tests use pallas_interpret); unsupported
+            # tiles and non-TPU backends take the fused XLA path
+            return corr81_xla(f1, f2)
         return corr81_pallas(f1, f2)
     raise ValueError(f"unknown corr impl {impl!r}; expected xla|pallas|pallas_interpret")

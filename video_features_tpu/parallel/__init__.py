@@ -7,6 +7,7 @@ from .mesh import (
     sharded_apply,
 )
 from .pipeline import maybe_initialize_distributed, prefetch_to_device, shard_video_list
+from .spatial import shard_spatial, sharded_conv_stack, sharded_same_conv2d
 
 __all__ = [
     "DATA_AXIS",
@@ -17,5 +18,8 @@ __all__ = [
     "sharded_apply",
     "maybe_initialize_distributed",
     "prefetch_to_device",
+    "shard_spatial",
+    "sharded_conv_stack",
+    "sharded_same_conv2d",
     "shard_video_list",
 ]
