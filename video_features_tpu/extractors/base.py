@@ -66,6 +66,21 @@ class Extractor(abc.ABC):
         with self.clock.stage("device_wait"):
             return np.asarray(device_out)
 
+    def _throttle(self, outputs: Sequence) -> None:
+        """Bound in-flight device work when per-batch results stay on device.
+
+        Deferring the host fetch to one per video removes the implicit
+        backpressure the old per-batch ``np.asarray`` provided; without a bound
+        the host dispatches every batch of a long video ahead of compute and
+        pins them all in HBM. Blocking on the (prefetch_depth+1)-oldest output
+        keeps at most ~prefetch_depth batches outstanding.
+        """
+        depth = max(self.cfg.prefetch_depth, 1)
+        if len(outputs) > depth:
+            import jax
+
+            jax.block_until_ready(outputs[-depth - 1])
+
     # --- shared driver ---
 
     def video_list(self) -> List[str]:

@@ -209,7 +209,9 @@ class ExtractI3D(Extractor):
             for stream in self.streams:
                 step = self._rgb_step if stream == "rgb" else self._flow_step
                 feats, logits = step(self.i3d_params[stream], dev_batch)
-                feats_dict[stream].append(self._wait(feats)[:valid])
+                # stays on device; one host fetch per stream per video
+                feats_dict[stream].append(feats[:valid])
+                self._throttle(feats_dict[stream])
                 if logits is not None:
                     logits = np.asarray(logits)[:valid]
                     for row, logit in enumerate(logits):
@@ -218,7 +220,7 @@ class ExtractI3D(Extractor):
                         show_predictions_on_dataset(logit[None], "kinetics")
 
         out = {
-            s: (np.concatenate(v, axis=0) if v else np.zeros((0, 1024), np.float32))
+            s: (self._wait(jnp.concatenate(v, axis=0)) if v else np.zeros((0, 1024), np.float32))
             for s, v in feats_dict.items()
         }
         out["fps"] = np.array(meta.fps)

@@ -90,19 +90,23 @@ class ExtractR21D(Extractor):
             chunk = slices[i : i + self.clips_per_batch]
             clips = np.stack([frames[s:e] for s, e in chunk])
             clips = self.runner.put(pad_batch(clips, self.clips_per_batch))
-            feats = self._wait(self._step(self.params, clips))[: len(chunk)]
-            vid_feats.append(feats)
-            if self.cfg.show_pred:
+            # stays on device; one host fetch per video
+            feats = self._step(self.params, clips)[: len(chunk)]
+            if self.cfg.show_pred:  # debug mode: fetch once, reuse for logits
+                feats = self._wait(feats)
                 fc = self.params["fc"]
                 logits = feats @ np.asarray(fc["kernel"]) + np.asarray(fc["bias"])
                 for (s, e), row in zip(chunk, logits):
                     print(f"{video_path} @ frames ({s}, {e})")
                     show_predictions_on_dataset(row[None], "kinetics")
+            vid_feats.append(feats)
+            self._throttle(vid_feats)
 
-        feats = (
-            np.concatenate(vid_feats, axis=0)
-            if vid_feats
-            else np.zeros((0, NUM_FEATURES), np.float32)
-        )
+        if not vid_feats:
+            feats = np.zeros((0, NUM_FEATURES), np.float32)
+        elif isinstance(vid_feats[0], np.ndarray):  # show_pred fetched per batch
+            feats = np.concatenate(vid_feats, axis=0)
+        else:
+            feats = self._wait(jnp.concatenate(vid_feats, axis=0))
         # reference returns features only for r21d (extract_r21d.py:123-125)
         return {self.feature_type: feats}

@@ -23,6 +23,8 @@ from typing import Dict
 
 import numpy as np
 
+import jax.numpy as jnp
+
 from ..audio.melspec import wav_to_examples
 from ..io import ffmpeg as ffmpeg_io
 from ..models.vggish import (
@@ -79,9 +81,11 @@ class ExtractVGGish(Extractor):
                 chunk = examples[i : i + self.example_batch]
                 valid = len(chunk)
                 batch = self.runner.put(pad_batch(chunk, self.example_batch))
-                feats.append(self._wait(self._step(self.params, batch))[:valid])
+                # stays on device; one host fetch per video
+                feats.append(self._step(self.params, batch)[:valid])
+                self._throttle(feats)
             out = (
-                np.concatenate(feats, axis=0)
+                self._wait(jnp.concatenate(feats, axis=0))
                 if feats
                 else np.zeros((0, EMBEDDING_SIZE), np.float32)
             )
