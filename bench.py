@@ -98,7 +98,7 @@ def _time_step(step, make_inputs, iters: int, repeats: int = 3):
         outs = [step(*ins[i]) for i in range(iters)]
         _force(outs)
         times.append(max(time.perf_counter() - t0 - sync, 1e-9) / iters)
-    return statistics.median(times), sync
+    return statistics.median(times), sync, iters
 
 
 def _timeit(fn) -> float:
@@ -154,7 +154,7 @@ def main() -> None:
         )
 
     def record(name, timing, units_per_iter, unit, flops_per_iter):
-        secs_per_iter, sync = timing
+        secs_per_iter, sync, iters_run = timing
         tflops = flops_per_iter / secs_per_iter / 1e12 if flops_per_iter else None
         entry = {
             "value": round(units_per_iter / secs_per_iter / n_chips, 3),
@@ -163,6 +163,10 @@ def main() -> None:
             "host_sync_sec": round(sync, 4),
             "achieved_tflops_per_sec": round(tflops, 2) if tflops else None,
         }
+        if iters_run * secs_per_iter < 3 * sync:
+            # signal below 3× the (jittery) sync latency: the subtraction can
+            # dominate the measurement — do not trust this entry's magnitude
+            entry["noise_limited"] = True
         if tflops and peak_tflops:
             entry["mfu_vs_peak"] = round(tflops / peak_tflops, 4)
         details[name] = entry
