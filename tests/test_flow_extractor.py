@@ -106,3 +106,27 @@ def test_raft_on_demand_corr_through_extractor(tmp_path):
         np.testing.assert_allclose(f_ond, f_vol, rtol=5e-2, atol=5e-2)
     finally:
         mp.undo()
+
+
+def test_show_pred_saves_viz_headless(tmp_path):
+    """--show_pred on a headless host writes frame+flow PNGs next to outputs."""
+    import os
+
+    mp = pytest.MonkeyPatch()
+    mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    mp.delenv("DISPLAY", raising=False)
+    mp.delenv("WAYLAND_DISPLAY", raising=False)
+    try:
+        cfg = ExtractionConfig(
+            feature_type="pwc", batch_size=2, show_pred=True, num_devices=1,
+            output_path=str(tmp_path / "o"), tmp_path=str(tmp_path / "t"),
+        )
+        ex = ExtractFlow(cfg)
+        frames = np.random.default_rng(0).uniform(0, 255, (3, 64, 64, 3)).astype(np.float32)
+        flow = ex._run_pairs(frames)
+        ex._show(frames[:-1], flow, "/videos/clip.mp4")
+        viz = ex.output_dir + "_viz"
+        pngs = sorted(os.listdir(viz))
+        assert pngs == ["clip_00000.png", "clip_00001.png"]
+    finally:
+        mp.undo()

@@ -20,6 +20,7 @@ prefetcher.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List
 
 import numpy as np
@@ -40,6 +41,7 @@ class ExtractFlow(Extractor):
         # pairs per device step, rounded to a multiple of the mesh size so the
         # sharded pair axis divides evenly (tail pairs repeat the last frame)
         self.batch_size = self.runner.device_batch(cfg.batch_size)
+        self._viz_counter = 0  # --show_pred PNG fallback numbering
         if self.feature_type == "raft":
             self.params = self.runner.put_replicated(
                 resolve_params(
@@ -110,13 +112,15 @@ class ExtractFlow(Extractor):
         flow_frames: List[np.ndarray] = []
         window: List[np.ndarray] = []
 
+        self._viz_counter = 0  # per-video PNG numbering
+
         def flush():
             if len(window) > 1:
                 stack = np.stack(window).astype(np.float32)
                 flow = self._run_pairs(stack)
                 flow_frames.extend(flow)
                 if self.cfg.show_pred:
-                    self._show(stack[:-1], flow)
+                    self._show(stack[:-1], flow, video_path)
 
         for rgb, pos in self._timed_frames(frames_iter):
             timestamps_ms.append(pos)
@@ -135,21 +139,40 @@ class ExtractFlow(Extractor):
             "timestamps_ms": np.array(timestamps_ms),
         }
 
-    def _show(self, frames: np.ndarray, flows: np.ndarray) -> None:
-        """Frame + color-wheel flow side by side (``extract_raft.py:165-178``);
-        falls back to printing flow stats where no display is available."""
+    def _show(self, frames: np.ndarray, flows: np.ndarray, video_path: str = "") -> None:
+        """Frame + color-wheel flow side by side (``extract_raft.py:165-178``).
+
+        Headless hosts (every TPU pod) have no display for ``cv2.imshow``; the
+        visualizations are written as ``<output>/<type>_viz/<stem>_NNNNN.png``
+        instead (the ``<stem>_<key>.npy`` naming convention), so ``--show_pred``
+        stays useful over ssh. Without OpenCV installed, degrades to a stats line.
+        """
+        try:
+            import cv2
+        except ImportError:
+            for flow in flows:
+                print(f"flow: mean |u|={np.abs(flow[0]).mean():.3f} "
+                      f"|v|={np.abs(flow[1]).mean():.3f} (no cv2 for visualization)")
+            return
+
         from ..utils.flow_viz import flow_to_image
 
+        stem = os.path.splitext(os.path.basename(video_path))[0] or "video"
+        # cv2.imshow can hard-crash (not raise) without a display server; only
+        # attempt it when one is advertised
+        has_display = bool(os.environ.get("DISPLAY") or os.environ.get("WAYLAND_DISPLAY"))
         for frame, flow in zip(frames, flows):
             img = flow_to_image(flow.transpose(1, 2, 0))
-            try:
-                import cv2
-
-                stacked = np.concatenate([frame.astype(np.uint8), img], axis=0)
-                cv2.imshow("frame + flow", cv2.cvtColor(stacked, cv2.COLOR_RGB2BGR))
-                cv2.waitKey(1)
-            except Exception:
-                print(
-                    f"flow: mean |u|={np.abs(flow[0]).mean():.3f} "
-                    f"|v|={np.abs(flow[1]).mean():.3f} viz {img.shape}"
-                )
+            stacked = np.concatenate([frame.astype(np.uint8), img], axis=0)
+            bgr = cv2.cvtColor(stacked, cv2.COLOR_RGB2BGR)
+            if has_display:
+                try:
+                    cv2.imshow("frame + flow", bgr)
+                    cv2.waitKey(1)
+                    continue
+                except Exception:
+                    has_display = False
+            viz_dir = self.output_dir + "_viz"
+            os.makedirs(viz_dir, exist_ok=True)
+            cv2.imwrite(os.path.join(viz_dir, f"{stem}_{self._viz_counter:05d}.png"), bgr)
+            self._viz_counter += 1
