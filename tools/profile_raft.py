@@ -1,0 +1,178 @@
+"""Stage-level RAFT timing on the live backend: where do the 1.5 s/step go?
+
+Times each stage of ``raft_forward`` (batch 16 × 256², the bench config) as its
+own jitted program with unique inputs per call (defeats the axon tunnel's
+result memoization — see bench.py methodology notes):
+
+- encoders: fnet(x1) + fnet(x2) + cnet(x1)
+- pyramid:  all-pairs einsum + 3 avg-pools
+- lookup20: 20 chained 4-level 9×9 window lookups (volume impl)
+- gru20:    20 scan iterations with the lookup replaced by a fixed corr tensor
+- full:     raft_forward volume / on_demand
+
+Run: python tools/profile_raft.py [batch] [side]
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+try:  # tunnel compiles dominate wall time; reuse bench.py's persistent cache
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
+from video_features_tpu.models import raft as R  # noqa: E402
+
+
+def _force(outs) -> float:
+    leaves = [l for l in jax.tree_util.tree_leaves(outs) if l is not None]
+    acc = None
+    for l in leaves:
+        v = l.ravel()[0].astype(jnp.float32)
+        acc = v if acc is None else acc + v
+    return float(acc)
+
+
+def time_fn(name, fn, mk_inputs, iters=4, repeats=3):
+    warm = fn(*mk_inputs())
+    _force(warm)
+    sync = statistics.median([_time(lambda: _force(warm)) for _ in range(3)])
+    times = []
+    for _ in range(repeats):
+        ins = [mk_inputs() for _ in range(iters)]
+        _force(ins)
+        t0 = time.perf_counter()
+        outs = [fn(*ins[i]) for i in range(iters)]
+        _force(outs)
+        times.append(max(time.perf_counter() - t0 - sync, 1e-9) / iters)
+    med = statistics.median(times)
+    print(f"{name:>12}: {med * 1e3:9.2f} ms/iter  (sync {sync * 1e3:.0f} ms)", flush=True)
+    return med
+
+
+def _time(f):
+    t0 = time.perf_counter()
+    f()
+    return time.perf_counter() - t0
+
+
+def main():
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    side = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    h8 = w8 = side // 8
+    rng = np.random.default_rng(0)
+    params = jax.device_put(R.raft_init_params(0))
+    print(f"backend={jax.default_backend()} batch={b} side={side}", flush=True)
+
+    def frames():
+        return jnp.asarray(rng.uniform(0, 255, (b, side, side, 3)).astype(np.float32))
+
+    def feats():
+        return jnp.asarray(rng.standard_normal((b, h8, w8, 256)).astype(np.float32))
+
+    def small(c):
+        return jnp.asarray(rng.standard_normal((b, h8, w8, c)).astype(np.float32))
+
+    # --- encoders ---
+    @jax.jit
+    def encoders(p, x1, x2):
+        f1 = R._encoder(p["fnet"], 2.0 * x1 / 255.0 - 1.0, "instance")
+        f2 = R._encoder(p["fnet"], 2.0 * x2 / 255.0 - 1.0, "instance")
+        c = R._encoder(p["cnet"], 2.0 * x1 / 255.0 - 1.0, "batch")
+        return f1, f2, c
+
+    time_fn("encoders", encoders, lambda: (params, frames(), frames()))
+
+    # --- pyramid build ---
+    @jax.jit
+    def pyramid(f1, f2):
+        return R._build_pyramid(f1, f2)
+
+    time_fn("pyramid", pyramid, lambda: (feats(), feats()))
+
+    # --- 20 lookups (volume) ---
+    @jax.jit
+    def lookup20(f1, f2, flow0):
+        pyr = R._build_pyramid(f1, f2)
+        coords0 = R.coords_grid(b, h8, w8)
+
+        def body(coords, _):
+            corr = R._lookup(pyr, coords)
+            # cheap data-dependent drift so iterations can't be collapsed
+            return coords + corr[..., :2] * 1e-3, None
+
+        coords, _ = lax.scan(body, coords0 + flow0, None, length=R.ITERS)
+        return coords
+
+    time_fn("lookup20", lookup20, lambda: (feats(), feats(), small(2)))
+
+    # --- 20 lookups (on-demand) ---
+    @jax.jit
+    def lookup20_od(f1, f2, flow0):
+        pyr = R._build_f2_pyramid(f2)
+        coords0 = R.coords_grid(b, h8, w8)
+
+        def body(coords, _):
+            corr = R._lookup_on_demand(f1, pyr, coords)
+            return coords + corr[..., :2] * 1e-3, None
+
+        coords, _ = lax.scan(body, coords0 + flow0, None, length=R.ITERS)
+        return coords
+
+    time_fn("lookup20_od", lookup20_od, lambda: (feats(), feats(), small(2)))
+
+    # --- 20 GRU iterations with fixed corr ---
+    n_corr = R.CORR_LEVELS * (2 * R.CORR_RADIUS + 1) ** 2
+
+    @jax.jit
+    def gru20(p, corr, net0, inp):
+        up = p["update_block"]
+        coords0 = R.coords_grid(b, h8, w8)
+
+        def body(carry, _):
+            net, coords1 = carry
+            flow = coords1 - coords0
+            motion = R._motion_encoder(up["encoder"], flow, corr)
+            net = R._sep_conv_gru(up["gru"], net, jnp.concatenate([inp, motion], -1))
+            delta = R.conv2d(up["flow_head"]["conv2"],
+                             R._relu(R.conv2d(up["flow_head"]["conv1"], net, 1, 1)), 1, 1)
+            return (net, coords1 + delta), None
+
+        (net, coords1), _ = lax.scan(body, (net0, coords0), None, length=R.ITERS)
+        mask = 0.25 * R.conv2d(up["mask.2"], R._relu(R.conv2d(up["mask.0"], net, 1, 1)), 1, 0)
+        return R._convex_upsample(coords1 - coords0, mask)
+
+    time_fn("gru20", gru20,
+            lambda: (params, small(n_corr), small(R.HIDDEN_DIM), small(R.CONTEXT_DIM)))
+
+    # --- full forward ---
+    @jax.jit
+    def full(p, x1, x2):
+        return R.raft_forward(p, x1, x2)
+
+    time_fn("full_volume", full, lambda: (params, frames(), frames()))
+
+    @jax.jit
+    def full_od(p, x1, x2):
+        return R.raft_forward(p, x1, x2, corr_impl="on_demand")
+
+    time_fn("full_od", full_od, lambda: (params, frames(), frames()))
+
+
+if __name__ == "__main__":
+    main()

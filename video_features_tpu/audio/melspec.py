@@ -10,8 +10,8 @@ Behavioral spec — ``/root/reference/models/vggish/vggish_src/``:
   log(mel + 0.01) (``:192-223``);
 - example framing into non-overlapping (96, 64) patches (``vggish_input.py:27-65``);
 - wav read: int16 → /32768.0, stereo averaged to mono, resampled to 16 kHz
-  (``vggish_input.py:68-87``; resampy there, polyphase scipy here — the ffmpeg
-  extraction path already emits the right rate, so resampling is the rare case).
+  (``vggish_input.py:68-87``) with the same kaiser-windowed-sinc algorithm the
+  reference pins (:mod:`video_features_tpu.audio.resample`).
 
 This stays host-side numpy: the DSP is microseconds per clip next to the VGG
 forward, and numpy keeps it bit-comparable with the reference's own numpy frontend.
@@ -108,11 +108,9 @@ def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
     if data.ndim > 1:
         data = np.mean(data, axis=1)
     if sample_rate != SAMPLE_RATE:
-        from scipy.signal import resample_poly
-        from fractions import Fraction
+        from .resample import resample
 
-        ratio = Fraction(SAMPLE_RATE, int(round(sample_rate))).limit_denominator(1000)
-        data = resample_poly(data, ratio.numerator, ratio.denominator)
+        data = resample(data, sample_rate, SAMPLE_RATE)
     log_mel = log_mel_spectrogram(data)
     features_rate = 1.0 / STFT_HOP_SECS
     window = int(round(EXAMPLE_WINDOW_SECS * features_rate))
