@@ -104,3 +104,32 @@ def test_bilinear_sample_matches_grid_sample():
     ).permute(0, 2, 3, 1).numpy()
     out = np.asarray(bilinear_sample(jnp.asarray(img), jnp.asarray(coords)))
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_matmul_lookup_bitwise_matches_gather(converted):
+    """The MXU one-hot-matmul window lookup is the same bits as the gather
+    lookup (fp32 CPU; the matmul runs at Precision.HIGHEST by construction)."""
+    _, params = converted
+    rng = np.random.default_rng(3)
+    f1 = rng.uniform(0, 255, (2, 48, 64, 3)).astype(np.float32)
+    f2 = rng.uniform(0, 255, (2, 48, 64, 3)).astype(np.float32)
+    mm = np.asarray(raft_forward(params, f1, f2, iters=6, corr_impl="volume"))
+    ga = np.asarray(raft_forward(params, f1, f2, iters=6, corr_impl="volume_gather"))
+    np.testing.assert_array_equal(mm, ga)
+
+
+def test_matmul_lookup_zero_padding_out_of_bounds(converted):
+    """Window centers pushed far outside the frame: all-zero one-hot rows must
+    reproduce the gather path's zero-padding exactly (not clamp-to-edge)."""
+    from video_features_tpu.models.raft import _build_pyramid, _lookup
+
+    rng = np.random.default_rng(4)
+    f1 = jnp.asarray(rng.standard_normal((1, 8, 8, 32)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((1, 8, 8, 32)).astype(np.float32))
+    pyr = _build_pyramid(f1, f2)
+    # coords straddling every boundary case incl. fully outside
+    coords = jnp.asarray(
+        rng.uniform(-6.0, 13.0, (1, 8, 8, 2)).astype(np.float32))
+    mm = np.asarray(_lookup(pyr, coords, "matmul"))
+    ga = np.asarray(_lookup(pyr, coords, "gather"))
+    np.testing.assert_array_equal(mm, ga)

@@ -40,7 +40,8 @@ from video_features_tpu.models import raft as R  # noqa: E402
 
 
 def _force(outs) -> float:
-    leaves = [l for l in jax.tree_util.tree_leaves(outs) if l is not None]
+    leaves = [l for l in jax.tree_util.tree_leaves(outs)
+              if l is not None and getattr(l, "size", 1)]
     acc = None
     for l in leaves:
         v = l.ravel()[0].astype(jnp.float32)
@@ -105,21 +106,30 @@ def main():
 
     time_fn("pyramid", pyramid, lambda: (feats(), feats()))
 
-    # --- 20 lookups (volume) ---
-    @jax.jit
-    def lookup20(f1, f2, flow0):
-        pyr = R._build_pyramid(f1, f2)
-        coords0 = R.coords_grid(b, h8, w8)
+    # --- 20 lookups (volume: matmul vs gather) ---
+    # the drift term consumes EVERY corr channel: a coords+corr[..., :2] probe
+    # lets XLA dead-code-eliminate 322 of 324 lookup channels (first profile
+    # run under-reported the gather cost 4×)
+    def lookup20_impl(impl):
+        @jax.jit
+        def lookup20(f1, f2, flow0):
+            pyr = R._build_pyramid(f1, f2)
+            coords0 = R.coords_grid(b, h8, w8)
 
-        def body(coords, _):
-            corr = R._lookup(pyr, coords)
-            # cheap data-dependent drift so iterations can't be collapsed
-            return coords + corr[..., :2] * 1e-3, None
+            def body(coords, _):
+                corr = R._lookup(pyr, coords, impl)
+                drift = jnp.stack([corr.sum(-1), corr.max(-1)], axis=-1)
+                return coords + drift * 1e-4, None
 
-        coords, _ = lax.scan(body, coords0 + flow0, None, length=R.ITERS)
-        return coords
+            coords, _ = lax.scan(body, coords0 + flow0, None, length=R.ITERS)
+            return coords
 
-    time_fn("lookup20", lookup20, lambda: (feats(), feats(), small(2)))
+        return lookup20
+
+    time_fn("lookup20_mm", lookup20_impl("matmul"),
+            lambda: (feats(), feats(), small(2)))
+    time_fn("lookup20_ga", lookup20_impl("gather"),
+            lambda: (feats(), feats(), small(2)))
 
     # --- 20 lookups (on-demand) ---
     @jax.jit
@@ -129,7 +139,8 @@ def main():
 
         def body(coords, _):
             corr = R._lookup_on_demand(f1, pyr, coords)
-            return coords + corr[..., :2] * 1e-3, None
+            drift = jnp.stack([corr.sum(-1), corr.max(-1)], axis=-1)
+            return coords + drift * 1e-4, None
 
         coords, _ = lax.scan(body, coords0 + flow0, None, length=R.ITERS)
         return coords
@@ -166,6 +177,12 @@ def main():
         return R.raft_forward(p, x1, x2)
 
     time_fn("full_volume", full, lambda: (params, frames(), frames()))
+
+    @jax.jit
+    def full_gather(p, x1, x2):
+        return R.raft_forward(p, x1, x2, corr_impl="volume_gather")
+
+    time_fn("full_gather", full_gather, lambda: (params, frames(), frames()))
 
     @jax.jit
     def full_od(p, x1, x2):

@@ -108,9 +108,13 @@ def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
     if data.ndim > 1:
         data = np.mean(data, axis=1)
     if sample_rate != SAMPLE_RATE:
-        from .resample import resample
+        from .resample import output_length, resample
 
-        data = resample(data, sample_rate, SAMPLE_RATE)
+        if output_length(data.shape[0], sample_rate, SAMPLE_RATE) < 1:
+            data = np.zeros(0, np.float64)  # degenerate/empty audio track:
+            # keep the (0, 96, 64) empty-examples contract of the 16 kHz path
+        else:
+            data = resample(data, sample_rate, SAMPLE_RATE)
     log_mel = log_mel_spectrogram(data)
     features_rate = 1.0 / STFT_HOP_SECS
     window = int(round(EXAMPLE_WINDOW_SECS * features_rate))

@@ -68,6 +68,12 @@ def _time_register(n_out: int, time_increment: float) -> np.ndarray:
     return reg
 
 
+def output_length(n_in: int, sr_orig: float, sr_new: float) -> int:
+    """``floor(n · ratio)`` — the kernel's output-length rule, exposed so
+    callers can detect degenerate (empty-output) inputs before calling."""
+    return int(n_in * (float(sr_new) / float(sr_orig)))
+
+
 def resample(x: np.ndarray, sr_orig: float, sr_new: float,
              filter: str = "kaiser_best", chunk: int = 8192) -> np.ndarray:
     """Resample 1-D ``x`` from ``sr_orig`` to ``sr_new``. float64 in/out math."""
@@ -79,7 +85,7 @@ def resample(x: np.ndarray, sr_orig: float, sr_new: float,
     sample_ratio = float(sr_new) / float(sr_orig)
     if sample_ratio == 1.0:
         return x.copy()
-    n_out = int(x.shape[0] * sample_ratio)
+    n_out = output_length(x.shape[0], sr_orig, sr_new)
     if n_out < 1:
         raise ValueError(f"input too short to resample (n={x.shape[0]}, ratio={sample_ratio})")
 

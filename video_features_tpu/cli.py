@@ -59,9 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="devices in the data-parallel mesh (default: all local)")
     parser.add_argument("--resume", action="store_true", default=False,
                         help="skip videos recorded in the output done-manifest")
-    parser.add_argument("--raft_corr", choices=["volume", "on_demand"], default="volume",
-                        help="RAFT correlation: materialized pyramid or on-demand "
-                             "(alt_cuda_corr equivalent, O(H*W) memory)")
+    parser.add_argument("--raft_corr", choices=["volume", "volume_gather", "on_demand"],
+                        default="volume",
+                        help="RAFT correlation: materialized pyramid with MXU matmul "
+                             "lookup (default), the same pyramid with gather lookup, "
+                             "or on-demand (alt_cuda_corr equivalent, O(H*W) memory)")
     parser.add_argument("--pwc_corr", choices=["xla", "pallas"], default="xla",
                         help="PWC cost-volume implementation")
     parser.add_argument("--profile_dir", default=None,

@@ -97,8 +97,8 @@ class ExtractionConfig:
             raise ValueError("batch_size must be >= 1")
         if self.clips_per_batch < 1:
             raise ValueError("clips_per_batch must be >= 1")
-        if self.raft_corr not in ("volume", "on_demand"):
-            raise ValueError("raft_corr must be 'volume' or 'on_demand'")
+        if self.raft_corr not in ("volume", "volume_gather", "on_demand"):
+            raise ValueError("raft_corr must be volume|volume_gather|on_demand")
         if self.pwc_corr not in ("xla", "pallas"):
             raise ValueError("pwc_corr must be 'xla' or 'pallas'")
         if self.matmul_precision not in (None, "default", "high", "highest"):
