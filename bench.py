@@ -256,6 +256,40 @@ def main() -> None:
         record(f"pwc_pairs_float32_{corr}_b{b}", timing, ex.batch_size, "pairs/sec/chip",
                _flops_of(ex._step, *mk_pwc()))
 
+    # ---- R(2+1)D: clips/sec, 16-frame 112² slices (reference r21d geometry) ---
+    if not on_cpu:
+        from video_features_tpu.extractors.r21d import ExtractR21D
+
+        for dtype in ("float32", "bfloat16"):
+            _log(f"r21d_{dtype}: building extractor + inputs")
+            ex = ExtractR21D(cfg("r21d_rgb", clips_per_batch=8, dtype=dtype))
+
+            def mk_r21d(ex=ex):
+                return (ex.params,
+                        ex.runner.put(rng.integers(
+                            0, 256, (ex.clips_per_batch, 16, 128, 171, 3),
+                            dtype=np.uint8)))
+
+            timing = _time_step(ex._step, mk_r21d, iters=8, repeats=_repeats(on_cpu))
+            record(f"r21d_{dtype}", timing, ex.clips_per_batch, "clips/sec/chip",
+                   _flops_of(ex._step, *mk_r21d()))
+
+    # ---- VGGish: 0.96s examples/sec --------------------------------------------
+    if not on_cpu:
+        from video_features_tpu.extractors.vggish import ExtractVGGish
+
+        _log("vggish: building extractor + inputs")
+        ex = ExtractVGGish(cfg("vggish"))
+
+        def mk_vggish(ex=ex):
+            return (ex.params,
+                    ex.runner.put(rng.standard_normal(
+                        (ex.example_batch, 96, 64)).astype(np.float32)))
+
+        timing = _time_step(ex._step, mk_vggish, iters=8, repeats=_repeats(on_cpu))
+        record("vggish_float32", timing, ex.example_batch, "examples/sec/chip",
+               _flops_of(ex._step, *mk_vggish()))
+
     # ---- ResNet-50 frames/sec (round-1 metric, kept for continuity) -----------
     batch = 4 if on_cpu else 64
     for dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):

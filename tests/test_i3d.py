@@ -99,3 +99,18 @@ def test_maxpool_tf_same_matches_torch_ceilmode():
         ref = torch.nn.functional.max_pool3d(t, kernel, stride, ceil_mode=True)
         out = np.asarray(max_pool_tf_same(jnp.asarray(x), kernel, stride))
         np.testing.assert_allclose(out, ref.permute(0, 2, 3, 4, 1).numpy(), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("t,h,w", [(16, 224, 224), (16, 63, 57)])
+def test_s2d_stem_matches_direct_conv(converted, modality, t, h, w):
+    """Space-to-depth stem lowering == direct stem conv (same params; the
+    folded taps only add zero products, so fp32 CPU agrees to ~1e-5)."""
+    _, params = converted
+    c = {"rgb": 3, "flow": 2}[modality]
+    x = jnp.asarray(
+        np.random.default_rng(5).uniform(-1, 1, (1, t, h, w, c)).astype(np.float32))
+    direct = I3D(modality=modality).apply({"params": params}, x, features=True)
+    s2d = I3D(modality=modality, s2d_stem=True).apply({"params": params}, x,
+                                                      features=True)
+    np.testing.assert_allclose(np.asarray(s2d), np.asarray(direct),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,65 @@
+"""Shared timing methodology for the stage profilers (profile_raft/profile_i3d).
+
+The axon tunnel backend memoizes identical (executable, args) calls and returns
+from ``block_until_ready`` without waiting, so honest timing needs (a) unique
+input arrays per call and (b) a forced host read that data-depends on every
+output leaf; the per-round host-sync latency is measured and subtracted
+(bench.py documents the full methodology).
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import time
+
+
+def enable_compilation_cache():
+    """Tunnel compiles dominate wall time; reuse bench.py's persistent cache."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+def force(outs) -> float:
+    """Force execution of every output with ONE host fetch (see bench.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [l for l in jax.tree_util.tree_leaves(outs)
+              if l is not None and getattr(l, "size", 1)]
+    acc = None
+    for l in leaves:
+        v = l.ravel()[0].astype(jnp.float32)
+        acc = v if acc is None else acc + v
+    return float(acc)
+
+
+def timeit(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def time_fn(name, fn, mk_inputs, iters=4, repeats=3):
+    """Median seconds/iteration with unique inputs per call; prints one line."""
+    warm = fn(*mk_inputs())
+    force(warm)  # compile + first execution
+    sync = statistics.median([timeit(lambda: force(warm)) for _ in range(3)])
+    times = []
+    for _ in range(repeats):
+        ins = [mk_inputs() for _ in range(iters)]
+        force(ins)  # input transfers completed pre-clock
+        t0 = time.perf_counter()
+        outs = [fn(*ins[i]) for i in range(iters)]
+        force(outs)
+        times.append(max(time.perf_counter() - t0 - sync, 1e-9) / iters)
+    med = statistics.median(times)
+    print(f"{name:>16}: {med * 1e3:9.2f} ms/iter  (sync {sync * 1e3:.0f} ms)",
+          flush=True)
+    return med

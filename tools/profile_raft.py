@@ -16,60 +16,23 @@ Run: python tools/profile_raft.py [batch] [side]
 from __future__ import annotations
 
 import os
-import statistics
 import sys
-import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 
-try:  # tunnel compiles dominate wall time; reuse bench.py's persistent cache
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+from _bench_util import enable_compilation_cache, time_fn  # noqa: E402
+
+enable_compilation_cache()
 
 from video_features_tpu.models import raft as R  # noqa: E402
-
-
-def _force(outs) -> float:
-    leaves = [l for l in jax.tree_util.tree_leaves(outs)
-              if l is not None and getattr(l, "size", 1)]
-    acc = None
-    for l in leaves:
-        v = l.ravel()[0].astype(jnp.float32)
-        acc = v if acc is None else acc + v
-    return float(acc)
-
-
-def time_fn(name, fn, mk_inputs, iters=4, repeats=3):
-    warm = fn(*mk_inputs())
-    _force(warm)
-    sync = statistics.median([_time(lambda: _force(warm)) for _ in range(3)])
-    times = []
-    for _ in range(repeats):
-        ins = [mk_inputs() for _ in range(iters)]
-        _force(ins)
-        t0 = time.perf_counter()
-        outs = [fn(*ins[i]) for i in range(iters)]
-        _force(outs)
-        times.append(max(time.perf_counter() - t0 - sync, 1e-9) / iters)
-    med = statistics.median(times)
-    print(f"{name:>12}: {med * 1e3:9.2f} ms/iter  (sync {sync * 1e3:.0f} ms)", flush=True)
-    return med
-
-
-def _time(f):
-    t0 = time.perf_counter()
-    f()
-    return time.perf_counter() - t0
 
 
 def main():

@@ -28,6 +28,7 @@ TPU design (vs the reference's one-stack-at-a-time GPU loop, ``:139-169``):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List
 
 import numpy as np
@@ -70,7 +71,12 @@ class ExtractI3D(Extractor):
         self.clips_per_batch = self.runner.device_batch(cfg.clips_per_batch)
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
-        self.i3d = {s: I3D(modality=s, dtype=self.dtype) for s in self.streams}
+        # VFT_I3D_S2D=1 opts into the space-to-depth stem lowering; measured
+        # SLOWER on v5e (the fold relayout costs more than the small-channel
+        # stem conv, which XLA already runs at ~20 TF/s — tools/profile_i3d.py)
+        s2d = os.environ.get("VFT_I3D_S2D") == "1"
+        self.i3d = {s: I3D(modality=s, s2d_stem=s2d, dtype=self.dtype)
+                    for s in self.streams}
         self.i3d_params = {
             s: self.runner.put_replicated(
                 resolve_params(

@@ -25,7 +25,13 @@ from typing import Any, Sequence, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .layers import TorchBatchNorm, avg_pool_valid, max_pool_tf_same, tf_same_pads
+from .layers import (
+    S2DStemConv,
+    TorchBatchNorm,
+    avg_pool_valid,
+    max_pool_tf_same,
+    tf_same_pads,
+)
 
 # (branch_0) (branch_1 reduce, branch_1 out) (branch_2 reduce, branch_2 out) (branch_3)
 MixedSpec = Tuple[int, int, int, int, int, int]
@@ -62,19 +68,25 @@ class Unit3D(nn.Module):
     use_bn: bool = True
     use_bias: bool = False
     relu: bool = True
+    s2d: bool = False  # space-to-depth lowering (7³/2³ stem only, see layers.py)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        x = nn.Conv(
-            self.features,
-            tuple(self.kernel),
-            strides=tuple(self.stride),
-            padding=tf_same_pads(self.kernel, self.stride),
-            use_bias=self.use_bias,
-            dtype=self.dtype,
-            name="conv3d",
-        )(x)
+        if self.s2d:
+            assert tuple(self.kernel) == (7, 7, 7) and tuple(self.stride) == (2, 2, 2)
+            assert not self.use_bias
+            x = S2DStemConv(self.features, dtype=self.dtype, name="conv3d")(x)
+        else:
+            x = nn.Conv(
+                self.features,
+                tuple(self.kernel),
+                strides=tuple(self.stride),
+                padding=tf_same_pads(self.kernel, self.stride),
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                name="conv3d",
+            )(x)
         if self.use_bn:
             x = TorchBatchNorm(dtype=self.dtype, name="batch3d")(x)
         if self.relu:
@@ -111,6 +123,7 @@ class I3D(nn.Module):
 
     num_classes: int = 400
     modality: str = "rgb"
+    s2d_stem: bool = False  # MXU space-to-depth stem (fp-reassociation only)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -124,7 +137,8 @@ class I3D(nn.Module):
         for op, name, *spec in I3D_STEM:
             if op == "conv":
                 feats, kernel, stride = spec
-                x = Unit3D(feats, kernel, stride, dtype=self.dtype, name=name)(x)
+                s2d = self.s2d_stem and name == "conv3d_1a_7x7"
+                x = Unit3D(feats, kernel, stride, s2d=s2d, dtype=self.dtype, name=name)(x)
             elif op == "pool":
                 kernel, stride = spec
                 x = max_pool_tf_same(x, kernel, stride)

@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.nnf import avg_pool2d, batch_norm_eval, conv2d, instance_norm
-from ..ops.warp import bilinear_sample, coords_grid
+from ..ops.warp import coords_grid
 
 HIDDEN_DIM = 128
 CONTEXT_DIM = 128
@@ -88,14 +88,39 @@ def _build_pyramid(f1: jnp.ndarray, f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
     return tuple(pyramid)
 
 
-def _delta_grid() -> jnp.ndarray:
-    """(9, 9, 2) window offsets with the reference's axis swap (corr.py:37-43):
-    grid position (i, j) samples displacement (δ_i in x, δ_j in y)."""
-    r = CORR_RADIUS
-    d = jnp.arange(-r, r + 1, dtype=jnp.float32)
-    dx = jnp.broadcast_to(d[:, None], (2 * r + 1, 2 * r + 1))  # varies along axis 0
-    dy = jnp.broadcast_to(d[None, :], (2 * r + 1, 2 * r + 1))  # varies along axis 1
-    return jnp.stack([dx, dy], axis=-1)  # (x, y) order
+def _int_window(c: jnp.ndarray):
+    """Integer tap indices and bilinear fractions for a 10×10 window.
+
+    ``c``: (..., 2) level-scaled window centers. Returns ``(ix, iy, fx, fy)``
+    with taps (..., 10) covering offsets −4…+5 (all 81 corners of the 9×9
+    window share these integer taps) and fractions (...,).
+    """
+    cf = jnp.floor(c)
+    off = jnp.arange(-CORR_RADIUS, CORR_RADIUS + 2, dtype=jnp.int32)  # (10,)
+    ix = cf[..., 0].astype(jnp.int32)[..., None] + off
+    iy = cf[..., 1].astype(jnp.int32)[..., None] + off
+    return ix, iy, c[..., 0] - cf[..., 0], c[..., 1] - cf[..., 1]
+
+
+def _combine_window(patch: jnp.ndarray, fx: jnp.ndarray, fy: jnp.ndarray) -> jnp.ndarray:
+    """(..., 10y, 10x) integer patch → (..., 81) bilinear window values.
+
+    Four shifted elementwise combinations (identical arithmetic to per-point
+    bilinear sampling: 4 products + 3 adds per value), flattened x-major —
+    channel k = i·9 + j samples (δ_i in x, δ_j in y), the reference's
+    delta-grid axis swap (corr.py:37-43) that the update-block weights were
+    trained against.
+    """
+    fx = fx[..., None, None]
+    fy = fy[..., None, None]
+    v = (
+        (1 - fy) * (1 - fx) * patch[..., :-1, :-1]
+        + (1 - fy) * fx * patch[..., :-1, 1:]
+        + fy * (1 - fx) * patch[..., 1:, :-1]
+        + fy * fx * patch[..., 1:, 1:]
+    )  # (..., 9y, 9x)
+    sw = jnp.swapaxes(v, -1, -2)  # x-major
+    return sw.reshape(sw.shape[:-2] + ((2 * CORR_RADIUS + 1) ** 2,))
 
 
 def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
@@ -122,8 +147,7 @@ def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
     b, h, w, _ = coords.shape
     r = CORR_RADIUS
     n = b * h * w
-    win = 2 * r + 2  # 10: integer offsets −4…+5 cover all 81 corners
-    off = jnp.arange(-r, r + 2, dtype=jnp.int32)  # (10,)
+    win = 2 * r + 2  # 10 taps per axis
     out = []
     for i, corr in enumerate(pyramid):
         hi, wi = corr.shape[1], corr.shape[2]
@@ -132,15 +156,11 @@ def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
             # out of bounds → zeros (the per-corner mask semantics)
             out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), jnp.float32))
             continue
-        c = (coords / 2**i).reshape(n, 2)
-        cf = jnp.floor(c)
-        fx = (c[:, 0] - cf[:, 0])[:, None, None]  # (N, 1, 1)
-        fy = (c[:, 1] - cf[:, 1])[:, None, None]
-        ix = cf[:, 0].astype(jnp.int32)[:, None] + off[None, :]  # (N, 10) x taps
-        iy = cf[:, 1].astype(jnp.int32)[:, None] + off[None, :]  # (N, 10) y taps
+        ix, iy, fx, fy = _int_window((coords / 2**i).reshape(n, 2))
         if impl == "matmul":
             # one-hot row/column selectors; comparisons against the level's
-            # iota leave out-of-bounds taps as all-zero rows (zero padding)
+            # iota leave out-of-bounds taps as all-zero rows — exactly the
+            # zero-padding semantics (grid_sample padding_mode='zeros')
             sy = (iy[:, :, None] == jnp.arange(hi, dtype=jnp.int32)[None, None, :])
             sx = (ix[:, :, None] == jnp.arange(wi, dtype=jnp.int32)[None, None, :])
             # HIGHEST: selection against 0/1 is exact in fp32 accumulation, so
@@ -153,28 +173,19 @@ def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
             patch = jnp.einsum("npj,nqj->npq", rows, sx.astype(corr.dtype),
                                precision=lax.Precision.HIGHEST)
         elif impl == "gather":
-            # zero padding: out-of-bounds integer taps contribute 0 (grid_sample
-            # padding_mode='zeros' semantics, per corner tap)
-            mx = (ix >= 0) & (ix <= wi - 1)
-            my = (iy >= 0) & (iy <= hi - 1)
-            ixc = jnp.clip(ix, 0, wi - 1)
-            iyc = jnp.clip(iy, 0, hi - 1)
-            # per-image indices (a global arange(n)·hi·wi base overflows int32
-            # for large frames × batch; per-image offsets are bounded by hi·wi)
-            idx = (iyc[:, :, None] * wi + ixc[:, None, :]).reshape(n, win * win)
+            # zero padding: mask out-of-bounds integer taps after a clipped
+            # gather; per-image indices (a global arange(n)·hi·wi base would
+            # overflow int32 for large frames × batch)
+            idx = (jnp.clip(iy, 0, hi - 1)[:, :, None] * wi
+                   + jnp.clip(ix, 0, wi - 1)[:, None, :]).reshape(n, win * win)
             patch = jnp.take_along_axis(corr.reshape(n, hi * wi), idx, axis=1)
             patch = patch.reshape(n, win, win)  # ONE gather per level
-            patch = patch * (my[:, :, None] & mx[:, None, :]).astype(patch.dtype)
+            mask = (((iy >= 0) & (iy <= hi - 1))[:, :, None]
+                    & ((ix >= 0) & (ix <= wi - 1))[:, None, :])
+            patch = patch * mask.astype(patch.dtype)
         else:
             raise ValueError(f"lookup impl must be matmul|gather, got {impl!r}")
-        v = (
-            (1 - fy) * (1 - fx) * patch[:, : win - 1, : win - 1]
-            + (1 - fy) * fx * patch[:, : win - 1, 1:]
-            + fy * (1 - fx) * patch[:, 1:, : win - 1]
-            + fy * fx * patch[:, 1:, 1:]
-        )  # (N, 9y, 9x) window values
-        # channel order k = i·9 + j with (δ_i in x, δ_j in y): x-major flatten
-        out.append(v.transpose(0, 2, 1).reshape(b, h, w, (2 * r + 1) ** 2))
+        out.append(_combine_window(patch, fx, fy).reshape(b, h, w, -1))
     return jnp.concatenate(out, axis=-1)  # (B, H, W, 4·81)
 
 
@@ -195,20 +206,40 @@ def _build_f2_pyramid(f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
 
 
 def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray) -> jnp.ndarray:
-    """Correlation window computed on the fly: gather pooled-f2 features at the
-    81 window points per level, dot with f1. Identical numerics to
-    ``_lookup(_build_pyramid(...))`` up to fp reduction order."""
+    """Correlation window computed on the fly from pooled-f2 features — the
+    memory-bounded path (O(H·W·D), no (H·W)² volume).
+
+    Bilinear interpolation commutes with the channel dot product, so instead of
+    sampling f2 at 81 fractional points (324 corner gathers of D-vectors per
+    query), gather ONE 10×10 integer patch of f2 vectors per query per level,
+    contract with f1 on the MXU, and form the 81 bilinear values as four
+    shifted combinations of the (10, 10) correlation patch — ~3× fewer
+    gathered bytes and one gather per level. Numerics identical to the
+    fractional-point formulation up to fp reduction order (the bilinear
+    weights multiply the same products)."""
     b, h, w, d = f1.shape
-    delta = _delta_grid()  # (9, 9, 2)
+    r = CORR_RADIUS
+    win = 2 * r + 2  # 10 taps per axis
     scale = 1.0 / math.sqrt(d)
     f1 = f1.astype(jnp.float32)
-    n_tap = (2 * CORR_RADIUS + 1) ** 2
     out = []
     for i, f2i in enumerate(f2_pyramid):
-        pts = coords.reshape(b, h * w, 1, 1, 2) / 2**i + delta  # (B, HW, 9, 9, 2)
-        smp = bilinear_sample(f2i, pts.reshape(b, h * w * n_tap, 1, 2))
-        smp = smp.reshape(b, h, w, n_tap, d)
-        out.append(jnp.einsum("bhwc,bhwkc->bhwk", f1, smp) * scale)
+        hi, wi = f2i.shape[1], f2i.shape[2]
+        if hi == 0 or wi == 0:
+            out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), jnp.float32))
+            continue
+        ix, iy, fx, fy = _int_window((coords / 2**i).reshape(b, h * w, 2))
+        idx = (jnp.clip(iy, 0, hi - 1)[:, :, :, None] * wi
+               + jnp.clip(ix, 0, wi - 1)[:, :, None, :])  # (B, HW, 10y, 10x)
+        flat = f2i.reshape(b, hi * wi, -1).astype(jnp.float32)
+        patch_f = jnp.take_along_axis(
+            flat[:, None], idx.reshape(b, 1, h * w * win * win)[..., None], axis=2
+        ).reshape(b, h * w, win, win, -1)  # (B, HW, 10, 10, D) one gather/level
+        patch = jnp.einsum("bnc,bnpqc->bnpq", f1.reshape(b, h * w, d), patch_f) * scale
+        mask = (((iy >= 0) & (iy <= hi - 1))[:, :, :, None]
+                & ((ix >= 0) & (ix <= wi - 1))[:, :, None, :])
+        patch = patch * mask
+        out.append(_combine_window(patch, fx, fy).reshape(b, h, w, -1))
     return jnp.concatenate(out, axis=-1)
 
 
