@@ -58,12 +58,13 @@ def _force(outs) -> float:
     import jax
     import jax.numpy as jnp
 
-    leaves = [l for l in jax.tree_util.tree_leaves(outs) if l is not None]
+    leaves = [l for l in jax.tree_util.tree_leaves(outs)
+              if l is not None and getattr(l, "size", 1)]
     acc = None
     for l in leaves:
         v = l.ravel()[0].astype(jnp.float32)
         acc = v if acc is None else acc + v
-    return float(acc)
+    return float(acc) if acc is not None else 0.0
 
 
 def _time_step(step, make_inputs, iters: int, repeats: int = 3):

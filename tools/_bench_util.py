@@ -1,17 +1,23 @@
-"""Shared timing methodology for the stage profilers (profile_raft/profile_i3d).
+"""Shared timing for the stage profilers (profile_raft / profile_i3d).
 
-The axon tunnel backend memoizes identical (executable, args) calls and returns
-from ``block_until_ready`` without waiting, so honest timing needs (a) unique
-input arrays per call and (b) a forced host read that data-depends on every
-output leaf; the per-round host-sync latency is measured and subtracted
-(bench.py documents the full methodology).
+The methodology of record lives in bench.py (unique inputs per call to defeat
+the axon tunnel's result memoization, one forced host read that data-depends on
+every output leaf, sync-latency subtraction, iteration auto-raise against the
+noise floor); this module re-exports it so the profilers and the bench can
+never drift apart.
 """
 
 from __future__ import annotations
 
 import os
-import statistics
-import time
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench as _bench  # noqa: E402 — repo-root bench.py
+
+force = _bench._force
+timeit = _bench._timeit
 
 
 def enable_compilation_cache():
@@ -26,40 +32,11 @@ def enable_compilation_cache():
         pass
 
 
-def force(outs) -> float:
-    """Force execution of every output with ONE host fetch (see bench.py)."""
-    import jax
-    import jax.numpy as jnp
-
-    leaves = [l for l in jax.tree_util.tree_leaves(outs)
-              if l is not None and getattr(l, "size", 1)]
-    acc = None
-    for l in leaves:
-        v = l.ravel()[0].astype(jnp.float32)
-        acc = v if acc is None else acc + v
-    return float(acc)
-
-
-def timeit(fn) -> float:
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
-
-
 def time_fn(name, fn, mk_inputs, iters=4, repeats=3):
-    """Median seconds/iteration with unique inputs per call; prints one line."""
-    warm = fn(*mk_inputs())
-    force(warm)  # compile + first execution
-    sync = statistics.median([timeit(lambda: force(warm)) for _ in range(3)])
-    times = []
-    for _ in range(repeats):
-        ins = [mk_inputs() for _ in range(iters)]
-        force(ins)  # input transfers completed pre-clock
-        t0 = time.perf_counter()
-        outs = [fn(*ins[i]) for i in range(iters)]
-        force(outs)
-        times.append(max(time.perf_counter() - t0 - sync, 1e-9) / iters)
-    med = statistics.median(times)
-    print(f"{name:>16}: {med * 1e3:9.2f} ms/iter  (sync {sync * 1e3:.0f} ms)",
-          flush=True)
-    return med
+    """Median seconds/iteration via bench._time_step (auto-raised iterations);
+    prints one line, flagging measurements still under 3× the sync latency."""
+    sec, sync, iters_run = _bench._time_step(fn, mk_inputs, iters, repeats)
+    flag = "  [noise-limited]" if iters_run * sec < 3 * sync else ""
+    print(f"{name:>16}: {sec * 1e3:9.2f} ms/iter  "
+          f"(sync {sync * 1e3:.0f} ms, iters {iters_run}){flag}", flush=True)
+    return sec

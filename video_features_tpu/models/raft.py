@@ -102,6 +102,22 @@ def _int_window(c: jnp.ndarray):
     return ix, iy, c[..., 0] - cf[..., 0], c[..., 1] - cf[..., 1]
 
 
+def _tap_index_mask(ix: jnp.ndarray, iy: jnp.ndarray, hi: int, wi: int):
+    """Clipped per-image flat indices and in-bounds mask for a (10y, 10x) patch.
+
+    ``idx`` (..., 10y, 10x) indexes a row-major (hi·wi) plane; ``mask`` zeroes
+    out-of-bounds taps after the clipped gather — the reference's zero-padding
+    semantics (grid_sample padding_mode='zeros', per corner tap). Per-image
+    offsets stay bounded by hi·wi (a global arange(n)·hi·wi base would overflow
+    int32 for large frames × batch).
+    """
+    idx = (jnp.clip(iy, 0, hi - 1)[..., :, None] * wi
+           + jnp.clip(ix, 0, wi - 1)[..., None, :])
+    mask = (((iy >= 0) & (iy <= hi - 1))[..., :, None]
+            & ((ix >= 0) & (ix <= wi - 1))[..., None, :])
+    return idx, mask
+
+
 def _combine_window(patch: jnp.ndarray, fx: jnp.ndarray, fy: jnp.ndarray) -> jnp.ndarray:
     """(..., 10y, 10x) integer patch → (..., 81) bilinear window values.
 
@@ -173,15 +189,10 @@ def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
             patch = jnp.einsum("npj,nqj->npq", rows, sx.astype(corr.dtype),
                                precision=lax.Precision.HIGHEST)
         elif impl == "gather":
-            # zero padding: mask out-of-bounds integer taps after a clipped
-            # gather; per-image indices (a global arange(n)·hi·wi base would
-            # overflow int32 for large frames × batch)
-            idx = (jnp.clip(iy, 0, hi - 1)[:, :, None] * wi
-                   + jnp.clip(ix, 0, wi - 1)[:, None, :]).reshape(n, win * win)
-            patch = jnp.take_along_axis(corr.reshape(n, hi * wi), idx, axis=1)
+            idx, mask = _tap_index_mask(ix, iy, hi, wi)
+            patch = jnp.take_along_axis(corr.reshape(n, hi * wi),
+                                        idx.reshape(n, win * win), axis=1)
             patch = patch.reshape(n, win, win)  # ONE gather per level
-            mask = (((iy >= 0) & (iy <= hi - 1))[:, :, None]
-                    & ((ix >= 0) & (ix <= wi - 1))[:, None, :])
             patch = patch * mask.astype(patch.dtype)
         else:
             raise ValueError(f"lookup impl must be matmul|gather, got {impl!r}")
@@ -229,15 +240,12 @@ def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray) -> jnp.n
             out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), jnp.float32))
             continue
         ix, iy, fx, fy = _int_window((coords / 2**i).reshape(b, h * w, 2))
-        idx = (jnp.clip(iy, 0, hi - 1)[:, :, :, None] * wi
-               + jnp.clip(ix, 0, wi - 1)[:, :, None, :])  # (B, HW, 10y, 10x)
+        idx, mask = _tap_index_mask(ix, iy, hi, wi)  # (B, HW, 10y, 10x)
         flat = f2i.reshape(b, hi * wi, -1).astype(jnp.float32)
         patch_f = jnp.take_along_axis(
             flat[:, None], idx.reshape(b, 1, h * w * win * win)[..., None], axis=2
         ).reshape(b, h * w, win, win, -1)  # (B, HW, 10, 10, D) one gather/level
         patch = jnp.einsum("bnc,bnpqc->bnpq", f1.reshape(b, h * w, d), patch_f) * scale
-        mask = (((iy >= 0) & (iy <= hi - 1))[:, :, :, None]
-                & ((ix >= 0) & (ix <= wi - 1))[:, :, None, :])
         patch = patch * mask
         out.append(_combine_window(patch, fx, fy).reshape(b, h, w, -1))
     return jnp.concatenate(out, axis=-1)

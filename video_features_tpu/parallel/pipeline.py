@@ -44,7 +44,17 @@ def maybe_initialize_distributed() -> bool:
         return jax.process_count() > 1
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if os.environ.get("VFT_MULTIHOST") == "1" or coord:
-        kwargs = {"coordinator_address": coord} if coord else {}
+        # On TPU pods initialize() self-configures from the metadata service;
+        # elsewhere (and in the loopback test) the standard JAX env vars name
+        # the job shape, but this jax version only auto-reads them for known
+        # cluster environments — pass them through explicitly when set.
+        kwargs = {}
+        if coord:
+            kwargs["coordinator_address"] = coord
+        if os.environ.get("JAX_NUM_PROCESSES"):
+            kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+        if os.environ.get("JAX_PROCESS_ID"):
+            kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
         jax.distributed.initialize(**kwargs)
         return jax.process_count() > 1
     return False
