@@ -130,3 +130,24 @@ def test_show_pred_saves_viz_headless(tmp_path):
         assert pngs == ["clip_00000.png", "clip_00001.png"]
     finally:
         mp.undo()
+
+
+def test_shape_bucket_bounds_compiles(tmp_path, monkeypatch):
+    """--shape_bucket 64: two different frame geometries pad into ONE bucket →
+    one compiled program; outputs keep the original (unpadded) shapes."""
+    monkeypatch.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    cfg = ExtractionConfig(
+        feature_type="raft", output_path=str(tmp_path / "o"),
+        tmp_path=str(tmp_path / "t"), batch_size=2, shape_bucket=64)
+    ex = ExtractFlow(cfg)
+    rng = np.random.default_rng(0)
+    flow_a = ex._run_pairs(rng.uniform(0, 255, (3, 40, 56, 3)).astype(np.float32))
+    flow_b = ex._run_pairs(rng.uniform(0, 255, (3, 48, 34, 3)).astype(np.float32))
+    assert flow_a.shape == (2, 2, 40, 56)
+    assert flow_b.shape == (2, 2, 48, 34)
+    assert ex._step._cache_size() == 1  # both geometries hit the 64x64 bucket
+
+
+def test_shape_bucket_validation():
+    with pytest.raises(ValueError, match="shape_bucket"):
+        ExtractionConfig(feature_type="raft", shape_bucket=12).validate()

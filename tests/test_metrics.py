@@ -2,8 +2,6 @@
 
 import time
 
-import numpy as np
-import pytest
 
 from video_features_tpu.utils.metrics import StageClock, maybe_profiler, metrics_enabled
 

@@ -12,7 +12,6 @@ hard-coded shapes that real pretrained checkpoints are known to have.
 
 import os
 
-import pytest
 
 
 def test_mirrors_do_not_import_flax_specs():

@@ -2,7 +2,6 @@
 
 import importlib.util
 import os
-import sys
 
 import numpy as np
 import pytest
@@ -149,3 +148,24 @@ def test_extract_wav(tmp_path, sample_video):
         assert np.isfinite(feats["vggish"]).all()
     finally:
         mp.undo()
+
+
+def test_postprocessor_real_audioset_pca_params():
+    """The genuine AudioSet PCA params the reference ships
+    (``models/vggish/checkpoints/vggish_pca_params.npz``, vendored in
+    ``sample/``) load and quantize correctly — the one reference checkpoint
+    small enough to test against for real."""
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "sample",
+                        "vggish_pca_params.npz")
+    pp = Postprocessor(path)
+    rng = np.random.default_rng(6)
+    emb = rng.standard_normal((3, 128)).astype(np.float32)
+    out = pp.postprocess(emb)
+    assert out.shape == (3, 128) and out.dtype == np.uint8
+    z = np.load(path)
+    ref = np.clip((z["pca_eigen_vectors"] @ (emb.T - z["pca_means"].reshape(-1, 1))).T,
+                  -2, 2)
+    ref = ((ref + 2) * (255.0 / 4.0)).astype(np.uint8)
+    np.testing.assert_array_equal(out, ref)

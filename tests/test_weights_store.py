@@ -15,7 +15,6 @@ import pytest
 
 from video_features_tpu.weights.store import (
     flatten_params,
-    load_params_npz,
     looks_like_tf_vars,
     resolve_params,
     save_params_npz,

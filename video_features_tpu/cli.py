@@ -66,6 +66,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "or on-demand (alt_cuda_corr equivalent, O(H*W) memory)")
     parser.add_argument("--pwc_corr", choices=["xla", "pallas"], default="xla",
                         help="PWC cost-volume implementation")
+    parser.add_argument("--shape_bucket", type=int, default=None,
+                        help="flow models: replicate-pad frames to multiples of this "
+                             "size (multiple of 8) so a mixed-resolution corpus "
+                             "compiles one program per bucket, not per geometry; "
+                             "off = reference-exact /8 padding only")
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 FEATURE_TYPES = ("i3d", "vggish", "r21d_rgb", "resnet50", "raft", "pwc")
 ON_EXTRACTION = ("print", "save_numpy")
@@ -63,6 +63,12 @@ class ExtractionConfig:
     # PWC cost volume: "xla" fused formulation (default) or the "pallas" tile
     # kernel (ops/pallas_corr).
     pwc_corr: str = "xla"
+    # Flow models: replicate-pad frames up to multiples of this size before the
+    # device step (flow unpadded after), so a mixed-resolution corpus compiles
+    # one program per BUCKET instead of one per distinct video geometry (tunnel
+    # compiles cost 20-100s each). Numerics caveat: like the reference's own /8
+    # pad, edge padding perturbs flow near borders — parity runs leave it off.
+    shape_bucket: Optional[int] = None
     # jax.profiler trace directory; also enables the per-video stage report
     # (decode vs device_wait vs overlapped time). VFT_METRICS=1 enables the
     # report without tracing.
@@ -103,6 +109,10 @@ class ExtractionConfig:
             raise ValueError("pwc_corr must be 'xla' or 'pallas'")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError("matmul_precision must be default|high|highest")
+        if self.shape_bucket is not None and (
+            self.shape_bucket < 8 or self.shape_bucket % 8
+        ):
+            raise ValueError("shape_bucket must be a multiple of 8 (RAFT /8 contract)")
 
     def replace(self, **kw) -> "ExtractionConfig":
         return dataclasses.replace(self, **kw)

@@ -6,9 +6,6 @@ in every ``extract_<name>.py`` (SURVEY.md §1); here it is factored once into
 the window plan, and the jitted device step.
 """
 
-from typing import TYPE_CHECKING
-
-
 def get_extractor(cfg):
     """Instantiate the extractor for ``cfg.feature_type`` (lazy imports keep
     startup light, mirroring the reference's in-branch imports ``main.py:15-33``)."""

@@ -26,7 +26,7 @@ from typing import Dict, List
 import numpy as np
 
 from ..io.video import open_video
-from ..models.raft import pad_to_multiple_of_8, raft_forward, raft_init_params, unpad
+from ..models.raft import pad_to_multiple, raft_forward, raft_init_params, unpad
 from ..ops.image import pil_edge_resize
 from ..weights.convert_torch import convert_raft
 from ..weights.store import resolve_params
@@ -90,12 +90,18 @@ class ExtractFlow(Extractor):
         if n_pairs < self.batch_size:
             reps = np.repeat(frames[-1:], self.batch_size - n_pairs, axis=0)
             frames = np.concatenate([frames, reps], axis=0)
-        if self._pads_input:
-            frames, pads = pad_to_multiple_of_8(frames)
+        # shape_bucket bounds compiled geometries across a mixed-resolution
+        # corpus (one program per bucket); RAFT otherwise pads to the /8
+        # contract only (reference behavior)
+        pads = None
+        if self.cfg.shape_bucket:
+            frames, pads = pad_to_multiple(frames, self.cfg.shape_bucket)
+        elif self._pads_input:
+            frames, pads = pad_to_multiple(frames, 8)
         prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
         nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
         flow = self._wait(self._step(self.params, prev, nxt))
-        if self._pads_input:
+        if pads is not None:
             flow = unpad(flow, pads)
         # NHWC → reference byte layout (B, 2, H, W)
         return flow[:n_pairs].transpose(0, 3, 1, 2)

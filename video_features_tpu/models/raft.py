@@ -352,18 +352,24 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     return _convex_upsample(coords1 - coords0, mask)
 
 
-def pad_to_multiple_of_8(frames: np.ndarray) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
-    """Replicate-pad (…, H, W, C) to /8 sizes, sintel split (raft.py:27-39).
+def pad_to_multiple(frames: np.ndarray, m: int) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """Replicate-pad (…, H, W, C) to multiples of ``m``, sintel split
+    (raft.py:27-39 semantics, generalized from 8 to any bucket size).
 
     Returns (padded, (top, bottom, left, right)) for :func:`unpad`.
     """
     h, w = frames.shape[-3:-1]
-    ph = (8 - h % 8) % 8
-    pw = (8 - w % 8) % 8
+    ph = (m - h % m) % m
+    pw = (m - w % m) % m
     top, bottom = ph // 2, ph - ph // 2
     left, right = pw // 2, pw - pw // 2
     pad = [(0, 0)] * (frames.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
     return np.pad(frames, pad, mode="edge"), (top, bottom, left, right)
+
+
+def pad_to_multiple_of_8(frames: np.ndarray) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """The reference's /8 input pad (raft.py:27-44)."""
+    return pad_to_multiple(frames, 8)
 
 
 def unpad(x: np.ndarray, pads: Tuple[int, int, int, int]) -> np.ndarray:
