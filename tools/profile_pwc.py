@@ -1,0 +1,118 @@
+"""Stage-level PWC-Net timing: pyramid extractor vs cost volumes vs warps vs
+dense decoders.
+
+Measured (v5e, batch 16 × 256², fp32): full 60 ms; extractor2x 92 ms
+standalone (materializing all 12 level outputs — inside the full forward the
+unused level-1 maps fuse away, but the extractor remains the dominant stage),
+corr_all 29 ms, warp_all ≤12 ms (noise-limited). Conclusion: PWC is bound by
+the small-channel pyramid convs (16-32 channels at 128²/64² — low MXU
+contraction depth), NOT by the warp gathers — no RAFT-style lookup surgery to
+do here.
+
+Same methodology as the other profilers (tools/_bench_util). Stages:
+
+- extractor2x: both 6-level feature pyramids
+- corr_all:    the 6 cost volumes (level 6 no-warp + 5 warped-target volumes)
+               on fixed features (no decoder chain)
+- warp_all:    the 5 Backward warps on fixed features/flows
+- full:        pwc_forward (xla cost volume)
+
+Run: python tools/profile_pwc.py [batch] [side]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from _bench_util import enable_compilation_cache, time_fn  # noqa: E402
+
+enable_compilation_cache()
+
+from video_features_tpu.models import pwc as P  # noqa: E402
+from video_features_tpu.ops.pallas_corr import corr81_xla  # noqa: E402
+from video_features_tpu.ops.warp import warp_backward  # noqa: E402
+
+
+def main():
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+    side = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    rng = np.random.default_rng(0)
+    params = jax.device_put(P.pwc_init_params(0))
+    print(f"backend={jax.default_backend()} batch={b} side={side}", flush=True)
+
+    # level dims at a /64-aligned input (PWC resizes internally; profile at the
+    # post-resize geometry): level l has side/2^l and these channel widths
+    chans = {1: 16, 2: 32, 3: 64, 4: 96, 5: 128, 6: 196}
+
+    def frames():
+        return jnp.asarray(rng.uniform(0, 255, (b, side, side, 3)).astype(np.float32))
+
+    def feats(level):
+        s = side // (2 ** level)
+        return jnp.asarray(
+            rng.standard_normal((b, s, s, chans[level])).astype(np.float32))
+
+    def flows(level):
+        s = side // (2 ** level)
+        return jnp.asarray(rng.standard_normal((b, s, s, 2)).astype(np.float32) * 2)
+
+    # --- both feature pyramids ---
+    @jax.jit
+    def extractor2x(p, x1, x2):
+        ext = p["moduleExtractor"]
+        return P._pyramid(ext, x1), P._pyramid(ext, x2)
+
+    time_fn("extractor2x", extractor2x, lambda: (params, frames(), frames()))
+
+    # --- 6 cost volumes on fixed features ---
+    @jax.jit
+    def corr_all(*fs):
+        outs = []
+        for i in range(0, len(fs), 2):
+            outs.append(corr81_xla(fs[i], fs[i + 1]))
+        return outs
+
+    def mk_corr():
+        out = []
+        for level in (2, 3, 4, 5, 6):
+            out += [feats(level), feats(level)]
+        return tuple(out)
+
+    time_fn("corr_all", corr_all, mk_corr)
+
+    # --- 5 warps on fixed features/flows ---
+    @jax.jit
+    def warp_all(*args):
+        outs = []
+        for i in range(0, len(args), 2):
+            outs.append(warp_backward(args[i], args[i + 1]))
+        return outs
+
+    def mk_warp():
+        out = []
+        for level in (2, 3, 4, 5):
+            out += [feats(level), flows(level)]
+        return tuple(out)
+
+    time_fn("warp_all", warp_all, mk_warp)
+
+    # --- full forward ---
+    @jax.jit
+    def full(p, x1, x2):
+        return P.pwc_forward(p, x1, x2)
+
+    time_fn("full", full, lambda: (params, frames(), frames()))
+
+
+if __name__ == "__main__":
+    main()
