@@ -1,0 +1,144 @@
+"""Cross-video decode prefetcher: equivalence with inline decode, memory
+bounding, and error isolation through the per-video fault barrier."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.extractors.resnet import ExtractResNet50
+from video_features_tpu.parallel.pipeline import DecodePrefetcher
+
+
+def _fake_open(path):
+    if path == "bad.mp4":
+        raise RuntimeError("corrupt container")
+    n = int(path.split("_")[1].split(".")[0])
+    meta = {"path": path, "fps": 25.0}
+    frames = ((np.full((4, 4, 3), i + n, np.uint8), float(i)) for i in range(n))
+    return meta, frames
+
+
+def test_prefetched_matches_inline():
+    pool = DecodePrefetcher(_fake_open, workers=2)
+    paths = [f"v_{n}.mp4" for n in (3, 5, 2)]
+    for p in paths:
+        pool.schedule(p)
+    try:
+        for p in paths:
+            meta, frames = pool.get(p)
+            want_meta, want_frames = _fake_open(p)
+            assert meta == want_meta
+            got = list(frames)
+            want = list(want_frames)
+            assert len(got) == len(want)
+            for (ga, gp), (wa, wp) in zip(got, want):
+                np.testing.assert_array_equal(ga, wa)
+                assert gp == wp
+    finally:
+        pool.shutdown()
+
+
+def test_unscheduled_path_decodes_inline():
+    pool = DecodePrefetcher(_fake_open, workers=1)
+    try:
+        meta, frames = pool.get("v_4.mp4")  # never scheduled
+        assert len(list(frames)) == 4
+    finally:
+        pool.shutdown()
+
+
+def test_decode_error_raised_at_consume():
+    pool = DecodePrefetcher(_fake_open, workers=2)
+    pool.schedule("bad.mp4")
+    pool.schedule("v_3.mp4")
+    try:
+        with pytest.raises(RuntimeError, match="corrupt"):
+            meta, frames = pool.get("bad.mp4")
+            list(frames)
+        meta, frames = pool.get("v_3.mp4")  # others unaffected
+        assert len(list(frames)) == 3
+    finally:
+        pool.shutdown()
+
+
+def test_buffer_bound_blocks_worker():
+    """A slow consumer must not let the worker buffer more than max_buffered."""
+    produced = []
+
+    def open_counting(path):
+        def gen():
+            for i in range(100):
+                produced.append(i)
+                yield np.zeros((2, 2, 3), np.uint8), float(i)
+        return {"path": path}, gen()
+
+    pool = DecodePrefetcher(open_counting, workers=1, max_buffered=8)
+    pool.schedule("x")
+    try:
+        time.sleep(0.5)  # worker runs ahead until the queue bound stops it
+        assert len(produced) <= 8 + 2  # queue cap + one in-flight + epsilon
+        meta, frames = pool.get("x")
+        assert len(list(frames)) == 100  # and everything still arrives
+    finally:
+        pool.shutdown()
+
+
+def test_shutdown_joins_threads():
+    pool = DecodePrefetcher(_fake_open, workers=2, max_buffered=2)
+    for n in (50, 60):
+        pool.schedule(f"v_{n}.mp4")
+    time.sleep(0.2)
+    pool.shutdown()  # workers blocked on full queues must exit
+    assert all(not t.is_alive() for t in pool._threads)
+    assert threading.active_count() < 20
+
+
+def test_extractor_run_with_decode_workers(tmp_path, sample_video, monkeypatch):
+    """End-to-end: --decode_workers 2 produces the same features as inline."""
+    monkeypatch.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+    def run(workers, sub):
+        cfg = ExtractionConfig(
+            feature_type="resnet50", on_extraction="save_numpy",
+            output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"),
+            batch_size=8, extraction_fps=2, decode_workers=workers)
+        ex = ExtractResNet50(cfg)
+        assert ex.run([sample_video, sample_video.replace(
+            "v_GGSY1Qvo990", "v_ZNVhz7ctTq0")]) == 2
+        import glob
+        return {p.split("/")[-1]: np.load(p)
+                for p in sorted(glob.glob(str(tmp_path / sub / "resnet50" / "*.npy")))}
+
+    inline = run(1, "a")
+    pooled = run(2, "b")
+    assert set(inline) == set(pooled) and len(inline) >= 4
+    for k in inline:
+        np.testing.assert_array_equal(inline[k], pooled[k])
+
+
+def test_release_frees_worker_after_abandoned_drain():
+    """A compute failure abandons the drain mid-video; release() must free the
+    worker's semaphore permit so later videos still decode (regression: with
+    one permit pinned per abandoned video, `workers` failures deadlocked the
+    whole run)."""
+    pool = DecodePrefetcher(_fake_open, workers=1, max_buffered=4)
+    paths = [f"v_{n}.mp4" for n in (100, 90, 80)]
+    for p in paths[:2]:
+        pool.schedule(p)
+    try:
+        for k, p in enumerate(paths):
+            pool.schedule(paths[min(k + 1, len(paths) - 1)])
+            meta, frames = pool.get(p)
+            next(frames)  # consume one frame...
+            pool.release(p)  # ...then the fault barrier abandons the video
+        # reaching here without hanging IS the assertion; also verify a fresh
+        # full video still streams end-to-end afterwards
+        pool.schedule("v_7.mp4")
+        meta, frames = pool.get("v_7.mp4")
+        assert len(list(frames)) == 7
+        pool.release("v_7.mp4")
+    finally:
+        pool.shutdown()

@@ -66,6 +66,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "or on-demand (alt_cuda_corr equivalent, O(H*W) memory)")
     parser.add_argument("--pwc_corr", choices=["xla", "pallas"], default="xla",
                         help="PWC cost-volume implementation")
+    parser.add_argument("--decode_workers", type=int, default=1,
+                        help="background threads decoding upcoming videos while the "
+                             "device computes (frame-stream models); 1 = inline")
     parser.add_argument("--shape_bucket", type=int, default=None,
                         help="flow models: replicate-pad frames to multiples of this "
                              "size (multiple of 8) so a mixed-resolution corpus "

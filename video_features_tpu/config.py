@@ -57,6 +57,11 @@ class ExtractionConfig:
     resume: bool = False
     # Host→HBM prefetch depth (double buffering by default).
     prefetch_depth: int = 2
+    # Cross-video decode parallelism: background threads decoding upcoming
+    # videos while the device computes (the reference gets this implicitly from
+    # thread-per-GPU; SPMD centralizes devices, so decode streams are explicit).
+    # 1 = inline decode. Frame-stream models only (resnet50, raft, pwc, i3d).
+    decode_workers: int = 1
     # RAFT correlation: "volume" materializes the all-pairs pyramid (reference
     # default); "on_demand" is the alt_cuda_corr equivalent — O(H·W·D) memory.
     raft_corr: str = "volume"
@@ -109,6 +114,8 @@ class ExtractionConfig:
             raise ValueError("pwc_corr must be 'xla' or 'pallas'")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError("matmul_precision must be default|high|highest")
+        if self.decode_workers < 1:
+            raise ValueError("decode_workers must be >= 1")
         if self.shape_bucket is not None and (
             self.shape_bucket < 8 or self.shape_bucket % 8
         ):

@@ -23,12 +23,19 @@ from ..io.output import (
     load_done_set,
     mark_done,
 )
+from ..io.video import open_video
 from ..parallel import MeshRunner
+from ..parallel.pipeline import DecodePrefetcher
 from ..utils.metrics import StageClock, maybe_profiler, metrics_enabled
 
 
 class Extractor(abc.ABC):
     """Base class for all per-model pipelines."""
+
+    # True for models that consume the open_video frame stream (resnet50, flow,
+    # i3d); r21d (whole-video torchvision-style decode) and vggish (audio)
+    # don't, so the decode pool would prefetch frames nobody reads
+    uses_frame_stream = False
 
     def __init__(self, cfg: ExtractionConfig):
         cfg = resolve_model_defaults(cfg)
@@ -44,12 +51,36 @@ class Extractor(abc.ABC):
         self.runner = MeshRunner(cfg.num_devices, cfg.matmul_precision)
         # per-video stage clock; active only when metrics are enabled (run())
         self.clock: Optional[StageClock] = None
+        # cross-video decode pool; created by run() when --decode_workers > 1
+        self._decode_pool: Optional[DecodePrefetcher] = None
 
     # --- per-model API ---
 
     @abc.abstractmethod
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         """Extract features for one video; keys become output-file suffixes."""
+
+    def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
+        """Per-frame host transform applied during decode (override per model)."""
+        return rgb
+
+    # --- decode (frame-stream models route through the prefetcher) ---
+
+    def _open_inline(self, video_path: str):
+        return open_video(
+            video_path,
+            extraction_fps=self.cfg.extraction_fps,
+            tmp_path=self.tmp_dir,
+            keep_tmp_files=self.cfg.keep_tmp_files,
+            transform=self._host_transform,
+        )
+
+    def _open_video(self, video_path: str):
+        """(meta, frames_iter) — prefetched by a decode worker when the pool
+        is active (``--decode_workers``), else decoded inline."""
+        if self._decode_pool is not None:
+            return self._decode_pool.get(video_path)
+        return self._open_inline(video_path)
 
     # --- observability hooks (no-ops unless metrics are enabled) ---
 
@@ -94,8 +125,16 @@ class Extractor(abc.ABC):
         paths = list(video_paths) if video_paths is not None else self.video_list()
         done = load_done_set(self.output_dir) if self.cfg.resume else set()
         with_metrics = metrics_enabled(self.cfg.profile_dir)
+        workers = self.cfg.decode_workers
+        if workers > 1 and self.uses_frame_stream:
+            self._decode_pool = DecodePrefetcher(self._open_inline, workers)
+        elif workers > 1:
+            print(f"--decode_workers ignored: {self.feature_type} does not "
+                  "consume the frame stream (whole-video / audio decode)")
+        todo = [p for p in paths if os.path.abspath(p) not in done]
         ok = 0
         extracted = 0  # excludes resume-skipped videos (throughput honesty)
+        cursor = 0  # decode-window cursor over `todo`
         t_run = time.perf_counter()
         with maybe_profiler(self.cfg.profile_dir):
             for n, path in enumerate(paths, start=1):
@@ -104,6 +143,11 @@ class Extractor(abc.ABC):
                     if progress:
                         progress(n, len(paths))
                     continue
+                if self._decode_pool is not None:
+                    # keep `workers` videos decoding ahead of the consumer
+                    for p in todo[cursor : cursor + workers]:
+                        self._decode_pool.schedule(p)
+                    cursor += 1
                 self.clock = StageClock() if with_metrics else None
                 t0 = time.perf_counter()
                 try:
@@ -124,8 +168,16 @@ class Extractor(abc.ABC):
                     print(f"Extraction failed at: {path} with error (↑). Continuing extraction")
                 finally:
                     self.clock = None
+                    if self._decode_pool is not None:
+                        # cancel this video's decode stream whether it was fully
+                        # drained or abandoned by a compute error — an orphaned
+                        # worker would pin a permit + max_buffered frames forever
+                        self._decode_pool.release(path)
                 if progress:
                     progress(n, len(paths))
+        if self._decode_pool is not None:
+            self._decode_pool.shutdown()
+            self._decode_pool = None
         if with_metrics and extracted:
             dt = time.perf_counter() - t_run
             print(f"extracted {extracted}/{len(paths)} videos "

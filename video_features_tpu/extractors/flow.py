@@ -25,7 +25,6 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..io.video import open_video
 from ..models.raft import pad_to_multiple, raft_forward, raft_init_params, unpad
 from ..ops.image import pil_edge_resize
 from ..weights.convert_torch import convert_raft
@@ -35,6 +34,8 @@ from .base import Extractor
 
 class ExtractFlow(Extractor):
     """feature_type 'raft' or 'pwc'; emits dense flow frames, not embeddings."""
+
+    uses_frame_stream = True
 
     def __init__(self, cfg):
         super().__init__(cfg)
@@ -107,13 +108,7 @@ class ExtractFlow(Extractor):
         return flow[:n_pairs].transpose(0, 3, 1, 2)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        meta, frames_iter = open_video(
-            video_path,
-            extraction_fps=self.cfg.extraction_fps,
-            tmp_path=self.tmp_dir,
-            keep_tmp_files=self.cfg.keep_tmp_files,
-            transform=self._host_transform,
-        )
+        meta, frames_iter = self._open_video(video_path)
         timestamps_ms: List[float] = []
         flow_frames: List[np.ndarray] = []
         window: List[np.ndarray] = []

@@ -36,7 +36,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..io.video import open_video
 from ..models.i3d import I3D, i3d_preprocess_flow, i3d_preprocess_rgb
 from ..models.pwc import pwc_forward, pwc_init_params
 from ..models.raft import raft_forward, raft_init_params
@@ -60,6 +59,8 @@ def _center_crop_nhwc(x: jnp.ndarray, size: int) -> jnp.ndarray:
 
 
 class ExtractI3D(Extractor):
+    uses_frame_stream = True
+
     def __init__(self, cfg):
         super().__init__(cfg)
         cfg = self.cfg  # model defaults resolved by the base class
@@ -173,14 +174,11 @@ class ExtractI3D(Extractor):
 
     # --- pipeline -----------------------------------------------------------
 
+    def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
+        return pil_edge_resize(rgb, PRE_CROP_SIZE)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        meta, frames_iter = open_video(
-            video_path,
-            extraction_fps=self.cfg.extraction_fps,
-            tmp_path=self.tmp_dir,
-            keep_tmp_files=self.cfg.keep_tmp_files,
-            transform=lambda rgb: pil_edge_resize(rgb, PRE_CROP_SIZE),
-        )
+        meta, frames_iter = self._open_video(video_path)
         feats_dict: Dict[str, list] = {s: [] for s in self.streams}
         timestamps_ms: List[float] = []
         valid_counts: List[int] = []

@@ -21,7 +21,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..io.video import open_video
 from ..models.resnet import ResNet50, preprocess_frames
 from ..parallel import prefetch_to_device
 from ..ops.image import np_center_crop_hwc, pil_edge_resize
@@ -35,6 +34,8 @@ CENTER_CROP_SIZE = 224
 
 
 class ExtractResNet50(Extractor):
+    uses_frame_stream = True
+
     def __init__(self, cfg):
         super().__init__(cfg)
         # round the user batch up to a multiple of the mesh size so the sharded
@@ -74,13 +75,7 @@ class ExtractResNet50(Extractor):
         return np_center_crop_hwc(rgb, CENTER_CROP_SIZE, CENTER_CROP_SIZE)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
-        meta, frames = open_video(
-            video_path,
-            extraction_fps=self.cfg.extraction_fps,
-            tmp_path=self.tmp_dir,
-            keep_tmp_files=self.cfg.keep_tmp_files,
-            transform=self._host_transform,
-        )
+        meta, frames = self._open_video(video_path)
         timestamps_ms = []
         valid_counts = []
 

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import collections
 import os
-from typing import Iterable, Iterator, List, Optional, Sequence
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -75,6 +77,136 @@ def shard_video_list(
     if process_count is None:
         process_count = jax.process_count()
     return list(paths[process_index::process_count])
+
+
+class DecodePrefetcher:
+    """Cross-video decode parallelism: background threads decode upcoming
+    videos while the device chews on the current one.
+
+    The reference gets decode parallelism implicitly — one Python thread per
+    GPU, each running its own decode loop (``/root/reference/main.py:43-47``).
+    The SPMD design centralizes devices behind one process, so when decode is
+    slower than compute (the common case: one cv2 stream decodes a few hundred
+    fps, the mesh consumes thousands), extra decode streams must be explicit.
+    cv2/ffmpeg/PIL release the GIL in their C cores, so threads parallelize.
+
+    ``open_fn(path) -> (meta, frames_iter)``; each worker drains one video's
+    iterator into a bounded queue (``max_buffered`` frames — memory cap), and
+    :meth:`get` hands back ``(meta, iterator)`` draining that queue. Paths are
+    scheduled by the run loop at most ``workers`` ahead of the consume cursor,
+    so total buffered frames stay ≤ workers · max_buffered. Decode errors are
+    re-raised at consume time — the per-video fault barrier sees them exactly
+    as inline decode would.
+    """
+
+    _DONE = object()
+
+    def __init__(self, open_fn: Callable, workers: int, max_buffered: int = 512):
+        if workers < 1:
+            raise ValueError("decode workers must be >= 1")
+        self._open = open_fn
+        self._max = max_buffered
+        self._slots: dict = {}  # scheduled, not yet consumed
+        self._handed: dict = {}  # handed to a consumer via get(), not released
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._sem = threading.Semaphore(workers)
+
+    def schedule(self, path: str) -> None:
+        """Start decoding ``path`` in the background (no-op if scheduled)."""
+        if path in self._slots or path in self._handed or self._stop.is_set():
+            return
+        self._threads = [t for t in self._threads if t.is_alive()]
+        slot = {
+            "q": queue.Queue(maxsize=self._max),
+            "meta": None,
+            "err": None,
+            "ready": threading.Event(),
+            "stop": threading.Event(),  # per-video cancel (release())
+        }
+        self._slots[path] = slot
+        t = threading.Thread(target=self._worker, args=(path, slot), daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _worker(self, path: str, slot: dict) -> None:
+        def stopped() -> bool:
+            return self._stop.is_set() or slot["stop"].is_set()
+
+        with self._sem:  # at most `workers` videos decoding concurrently
+            try:
+                if stopped():
+                    return
+                meta, frames = self._open(path)
+                slot["meta"] = meta
+                slot["ready"].set()
+                for item in frames:
+                    while not stopped():
+                        try:
+                            slot["q"].put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if stopped():
+                        return
+            except Exception as e:  # noqa: BLE001 — re-raised at consume time
+                slot["err"] = e
+            finally:
+                slot["ready"].set()
+                while not stopped():
+                    try:
+                        slot["q"].put(self._DONE, timeout=0.2)
+                        break
+                    except queue.Full:  # consumer will drain; retry
+                        continue
+
+    def get(self, path: str):
+        """(meta, frames_iter) for ``path`` — prefetched if scheduled, else
+        decoded inline. Pair every get() with :meth:`release` (the run loop
+        does this in its per-video ``finally``): an abandoned iterator — e.g.
+        the per-video fault barrier caught a compute error mid-drain — would
+        otherwise pin its worker thread and semaphore permit forever.
+        """
+        slot = self._slots.pop(path, None)
+        if slot is None:
+            return self._open(path)
+        self._handed[path] = slot
+        slot["ready"].wait()
+        if slot["err"] is not None and slot["meta"] is None:
+            raise slot["err"]
+
+        def drain() -> Iterator[Tuple[np.ndarray, float]]:
+            while True:
+                item = slot["q"].get()
+                if item is self._DONE:
+                    if slot["err"] is not None:
+                        raise slot["err"]
+                    return
+                yield item
+
+        return slot["meta"], drain()
+
+    def release(self, path: str) -> None:
+        """Cancel/forget a video's decode (no-op for finished or unknown ones)."""
+        slot = self._handed.pop(path, None) or self._slots.pop(path, None)
+        if slot is not None:
+            slot["stop"].set()
+            try:  # a consumer mid-drain must not hang on an exiting worker
+                slot["q"].put_nowait(self._DONE)
+            except queue.Full:
+                pass
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for slot in list(self._slots.values()) + list(self._handed.values()):
+            try:  # unblock any drain() consumers
+                slot["q"].put_nowait(self._DONE)
+            except queue.Full:
+                pass  # consumer has items to drain before it can block
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._slots.clear()
+        self._handed.clear()
 
 
 def prefetch_to_device(
