@@ -150,6 +150,15 @@ def main() -> None:
     rng = np.random.default_rng(0)
     details = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
     peak_tflops = float(os.environ.get("VFT_PEAK_TFLOPS", 0)) or None
+    if peak_tflops is None:
+        # published bf16 peaks per chip (the MXU runs bf16 passes even for fp32
+        # inputs at default precision, so bf16 peak is the MFU denominator)
+        known = {"v5 lite": 197.0, "v5litepod": 197.0, "v4": 275.0,
+                 "v5p": 459.0, "v6 lite": 918.0}
+        dev = details["device"].lower()
+        peak_tflops = next((v for k, v in known.items() if k in dev), None)
+        if peak_tflops:
+            details["peak_tflops_bf16_assumed"] = peak_tflops
 
     def cfg(feature_type, **kw):
         return ExtractionConfig(

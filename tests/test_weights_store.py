@@ -147,3 +147,19 @@ def test_exported_weights_drive_the_model(ckpt_dir):
 def test_missing_checkpoint_raises_without_random_flag(ckpt_dir):
     with pytest.raises(FileNotFoundError):
         resolve_params("resnet50")
+
+
+def test_orbax_roundtrip_through_store(tmp_path):
+    """Orbax checkpoint directories resolve through the store like .npz files."""
+    pytest.importorskip("orbax.checkpoint")
+    from video_features_tpu.weights.store import load_params_orbax, save_params_orbax
+
+    params = {"conv1": {"kernel": np.arange(12, dtype=np.float32).reshape(2, 2, 3),
+                        "bias": np.zeros(3, np.float32)},
+              "bn": {"scale": np.ones(3, np.float32)}}
+    path = save_params_orbax(str(tmp_path / "model.orbax"), params)
+    got = load_params_orbax(path)
+    assert set(got) == {"conv1", "bn"}
+    np.testing.assert_array_equal(got["conv1"]["kernel"], params["conv1"]["kernel"])
+    via_store = resolve_params("model", checkpoint_path=path)
+    np.testing.assert_array_equal(via_store["conv1"]["bias"], params["conv1"]["bias"])

@@ -131,7 +131,18 @@ class Extractor(abc.ABC):
         elif workers > 1:
             print(f"--decode_workers ignored: {self.feature_type} does not "
                   "consume the frame stream (whole-video / audio decode)")
+        try:
+            return self._run_loop(paths, done, with_metrics, progress)
+        finally:
+            # KeyboardInterrupt / a raising progress callback must not leak
+            # decode workers busy-waiting on full queues
+            if self._decode_pool is not None:
+                self._decode_pool.shutdown()
+                self._decode_pool = None
+
+    def _run_loop(self, paths, done, with_metrics, progress) -> int:
         todo = [p for p in paths if os.path.abspath(p) not in done]
+        workers = self.cfg.decode_workers
         ok = 0
         extracted = 0  # excludes resume-skipped videos (throughput honesty)
         cursor = 0  # decode-window cursor over `todo`
@@ -175,9 +186,6 @@ class Extractor(abc.ABC):
                         self._decode_pool.release(path)
                 if progress:
                     progress(n, len(paths))
-        if self._decode_pool is not None:
-            self._decode_pool.shutdown()
-            self._decode_pool = None
         if with_metrics and extracted:
             dt = time.perf_counter() - t_run
             print(f"extracted {extracted}/{len(paths)} videos "

@@ -9,6 +9,7 @@ binary is absent, fps changes fall back to index-based frame sampling in the dec
 
 from __future__ import annotations
 
+import hashlib
 import os
 import pathlib
 import shutil
@@ -28,8 +29,12 @@ def have_ffmpeg() -> bool:
 def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: int) -> str:
     """Re-encode ``video_path`` at ``extraction_fps`` into ``tmp_path``; return new path.
 
-    Matches ``utils/utils.py:147-169`` (same ``<stem>_new_fps.mp4`` naming so
-    ``keep_tmp_files`` behaves identically).
+    Matches ``utils/utils.py:147-169`` behavior; the tmp name extends the
+    reference's ``<stem>_new_fps.mp4`` with a short source-path hash — two
+    same-basename videos from different directories (decoded concurrently by
+    ``--decode_workers``, or sequentially with ``keep_tmp_files``) must not
+    share one tmp file (ffmpeg runs with ``-y``: the second would overwrite
+    the first mid-read).
     """
     if not have_ffmpeg():
         raise RuntimeError(
@@ -39,7 +44,9 @@ def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps:
     if not video_path.endswith(".mp4"):
         raise ValueError("The file does not end with .mp4")
     os.makedirs(tmp_path, exist_ok=True)
-    new_path = os.path.join(tmp_path, f"{pathlib.Path(video_path).stem}_new_fps.mp4")
+    tag = hashlib.md5(os.path.abspath(video_path).encode()).hexdigest()[:8]
+    new_path = os.path.join(
+        tmp_path, f"{pathlib.Path(video_path).stem}_{tag}_new_fps.mp4")
     cmd = [
         which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
         "-i", video_path, "-filter:v", f"fps=fps={extraction_fps}", new_path,

@@ -11,7 +11,7 @@ Resolution order for model key ``<name>``:
 2. ``$VFT_CHECKPOINT_DIR/<name>.npz`` (converted Flax params, flat ``a/b/c`` keys)
 3. ``./checkpoints/<name>.npz``
 4. a torch file at either location (``<name>.pt``/``.pth``) run through the model's
-   converter (requires torch)
+   converter (requires torch), or an orbax checkpoint directory (``<name>.orbax``)
 5. random init iff ``$VFT_ALLOW_RANDOM_WEIGHTS=1`` or ``allow_random=True``
 """
 
@@ -63,8 +63,28 @@ def _candidates(name: str):
         dirs.append(os.environ[ENV_DIR])
     dirs.append("./checkpoints")
     for d in dirs:
-        for ext in (".npz", ".pt", ".pth"):
+        for ext in (".npz", ".pt", ".pth", ".orbax"):
             yield os.path.join(d, name + ext)
+
+
+def save_params_orbax(dir_path: str, params: dict) -> str:
+    """Write ``params`` as an orbax checkpoint directory (``<name>.orbax``).
+
+    The ``.npz`` flat format stays the store's default (single file, no extra
+    deps at load time); orbax is the JAX-ecosystem interchange format (sharded,
+    async-capable) for pipelines that already speak it (SURVEY.md §5).
+    """
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(dir_path)
+    ocp.PyTreeCheckpointer().save(path, params, force=True)
+    return path
+
+
+def load_params_orbax(dir_path: str) -> dict:
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer().restore(os.path.abspath(dir_path))
 
 
 def random_params_like(init_fn: Callable, *args, seed: int = 0) -> dict:
@@ -132,6 +152,8 @@ def resolve_params(
             if convert_tf_fn is not None and looks_like_tf_vars(flat):
                 return convert_tf_fn(flat)
             return unflatten_params(flat)
+        if path.endswith(".orbax"):
+            return load_params_orbax(path)
         if convert_torch_fn is None:
             raise ValueError(f"{path}: torch checkpoint given but no converter for {name}")
         import torch  # local import: torch is host-side tooling only
@@ -147,6 +169,7 @@ def resolve_params(
         return init_fn()
     raise FileNotFoundError(
         f"no checkpoint found for {name!r} (searched {paths}); place converted "
-        f"weights at $VFT_CHECKPOINT_DIR/{name}.npz or a torch checkpoint at "
-        f"./checkpoints/{name}.pt, or set {ENV_ALLOW_RANDOM}=1 for random weights"
+        f"weights at $VFT_CHECKPOINT_DIR/{name}.npz (or {name}.orbax), a torch "
+        f"checkpoint at ./checkpoints/{name}.pt, or set {ENV_ALLOW_RANDOM}=1 "
+        f"for random weights"
     )
