@@ -95,3 +95,14 @@ def test_config_rejects_bad_round2_values():
         ExtractionConfig(feature_type="pwc", pwc_corr="cupy").validate()
     with pytest.raises(ValueError):
         ExtractionConfig(feature_type="i3d", matmul_precision="bf16").validate()
+
+
+def test_cli_decode_and_bucket_knobs():
+    cfg = parse_args([
+        "--feature_type", "raft", "--video_paths", "a.mp4",
+        "--decode_workers", "3", "--shape_bucket", "64",
+        "--raft_corr", "volume_gather",
+    ])
+    assert cfg.decode_workers == 3
+    assert cfg.shape_bucket == 64
+    assert cfg.raft_corr == "volume_gather"
