@@ -74,9 +74,10 @@ def _time_step(step, make_inputs, iters: int, repeats: int = 3):
     defeat the backend's result memoization); the per-round host-sync latency
     is measured separately and subtracted. ``iters`` is a lower bound — it is
     auto-raised until one round's compute is ≥ ~6× the sync latency (capped at
-    128 iterations / ~400 MB of unique inputs per round), else the subtraction
-    is noise-dominated (observed: a fast config reporting 0.0 s/iter).
-    Returns (sec_per_iter, sync_sec).
+    128 iterations / ~1 GB of unique per-call inputs per round), else the
+    subtraction is noise-dominated (observed: a fast config reporting 0.0
+    s/iter). Returns (sec_per_iter, sync_sec, iters_run) — ``iters_run`` feeds
+    the ``noise_limited`` flag in ``record()``.
     """
     warm_in = make_inputs()
     warm = step(*warm_in)
@@ -84,10 +85,17 @@ def _time_step(step, make_inputs, iters: int, repeats: int = 3):
     # tunnel host-sync latency baseline (median of 3)
     sync = statistics.median([_timeit(lambda: _force(warm)) for _ in range(3)])
     # single-iteration estimate (inputs pre-built: the estimate must not count
-    # host RNG/transfer time, which would undersize iters for fast configs)
-    est_in = make_inputs()
-    _force(est_in)
-    est = max(_timeit(lambda: _force(step(*est_in))) - sync, 1e-4)
+    # host RNG/transfer time, which would undersize iters for fast configs).
+    # Median of 3 with distinct inputs (memoization!): one noisy estimate
+    # OVERestimating a fast config under-sizes the auto-raise below and the
+    # measurement lands noise-limited (observed on a ~5 ms resnet step
+    # against a ~100 ms sync)
+    ests = []
+    for _ in range(3):
+        est_in = make_inputs()
+        _force(est_in)
+        ests.append(_timeit(lambda: _force(step(*est_in))))  # noqa: B023
+    est = max(statistics.median(ests) - sync, 1e-4)
     # the unique-input budget counts only args rebuilt per call (same-object
     # args — pinned replicated params — transfer once, not per iteration)
     fresh = [i for i, (a, w) in enumerate(zip(est_in, warm_in)) if a is not w]
