@@ -172,14 +172,21 @@ def main() -> None:
     details = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
     peak_tflops = float(os.environ.get("VFT_PEAK_TFLOPS", 0)) or None
     if peak_tflops is None:
-        # published bf16 peaks per chip (the MXU runs bf16 passes even for fp32
-        # inputs at default precision, so bf16 peak is the MFU denominator)
-        known = {"v5 lite": 197.0, "v5litepod": 197.0, "v4": 275.0,
-                 "v5p": 459.0, "v6 lite": 918.0}
-        dev = details["device"].lower()
-        peak_tflops = next((v for k, v in known.items() if k in dev), None)
+        # published bf16 peaks per chip (the MFU denominator for MXU work),
+        # keyed by the parsed (generation, variant) — not substring matching,
+        # which could false-match future device strings (e.g. 'v4' in 'v40')
+        import re
+
+        known = {("4", ""): 275.0, ("5", "lite"): 197.0, ("5", "e"): 197.0,
+                 ("5", "p"): 459.0, ("6", "lite"): 918.0, ("6", "e"): 918.0}
+        m = re.search(r"v(\d+)\s*(lite|p|e)?", details["device"].lower())
+        peak_tflops = known.get((m.group(1), m.group(2) or "")) if m else None
         if peak_tflops:
             details["peak_tflops_bf16_assumed"] = peak_tflops
+        else:
+            _log(f"no published peak-TFLOPs entry for device "
+                 f"{details['device']!r}; MFU columns will be omitted "
+                 f"(override with VFT_PEAK_TFLOPS)")
 
     def cfg(feature_type, **kw):
         return ExtractionConfig(

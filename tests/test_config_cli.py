@@ -106,3 +106,11 @@ def test_cli_decode_and_bucket_knobs():
     assert cfg.decode_workers == 3
     assert cfg.shape_bucket == 64
     assert cfg.raft_corr == "volume_gather"
+
+
+def test_cli_vggish_postprocess_flag():
+    cfg = parse_args(["--feature_type", "vggish", "--video_paths", "a.wav",
+                      "--vggish_postprocess"])
+    assert cfg.vggish_postprocess is True
+    assert parse_args(["--feature_type", "vggish", "--video_paths", "a.wav"]
+                      ).vggish_postprocess is False

@@ -6,6 +6,8 @@ divergence to the first wrong layer. Runs on CPU (conftest) — fp32 exact."""
 
 import pytest
 
+pytestmark = pytest.mark.slow  # whole-model parity: minutes on CPU
+
 from tools.layer_diff import i3d_layer_diff, raft_layer_diff
 
 

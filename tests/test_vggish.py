@@ -169,3 +169,30 @@ def test_postprocessor_real_audioset_pca_params():
                   -2, 2)
     ref = ((ref + 2) * (255.0 / 4.0)).astype(np.uint8)
     np.testing.assert_array_equal(out, ref)
+
+
+def test_vendored_pca_params_match_sample_fixture(monkeypatch):
+    """--vggish_postprocess resolves the vendored package copy, which must stay
+    byte-identical to the sample/ fixture (itself byte-identical to the
+    reference's AudioSet checkpoint)."""
+    import os
+
+    from video_features_tpu.config import ExtractionConfig
+    from video_features_tpu.extractors.vggish import ExtractVGGish
+
+    monkeypatch.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    vendored = os.path.join(repo, "video_features_tpu", "weights", "data",
+                            "vggish_pca_params.npz")
+    fixture = os.path.join(repo, "sample", "vggish_pca_params.npz")
+    with open(vendored, "rb") as a, open(fixture, "rb") as b:
+        assert a.read() == b.read()
+
+    cfg = ExtractionConfig(feature_type="vggish", vggish_postprocess=True,
+                           output_path="/tmp/vft_pca_out", tmp_path="/tmp/vft_pca_tmp")
+    ex = ExtractVGGish(cfg)
+    assert ex.postprocessor is not None
+    emb = np.zeros((2, 128), np.float32)
+    out = ex.postprocessor.postprocess(emb)
+    assert out.shape == (2, 128) and out.dtype == np.uint8

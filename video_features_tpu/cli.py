@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "size (multiple of 8) so a mixed-resolution corpus "
                              "compiles one program per bucket, not per geometry; "
                              "off = reference-exact /8 padding only")
+    parser.add_argument("--vggish_postprocess", action="store_true", default=False,
+                        help="apply the AudioSet PCA-whiten + uint8 quantize "
+                             "postprocessor to VGGish embeddings (vendored params; "
+                             "the reference loads but never applies it)")
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")

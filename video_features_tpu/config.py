@@ -74,6 +74,10 @@ class ExtractionConfig:
     # compiles cost 20-100s each). Numerics caveat: like the reference's own /8
     # pad, edge padding perturbs flow near borders — parity runs leave it off.
     shape_bucket: Optional[int] = None
+    # VGGish: apply the AudioSet PCA-whiten + uint8 quantize postprocessor
+    # (vendored params). Off by default — the reference constructs the
+    # postprocessor but never applies it (extract_vggish.py:57,104-116).
+    vggish_postprocess: bool = False
     # jax.profiler trace directory; also enables the per-video stage report
     # (decode vs device_wait vs overlapped time). VFT_METRICS=1 enables the
     # report without tracing.

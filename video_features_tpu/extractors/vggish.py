@@ -54,8 +54,14 @@ class ExtractVGGish(Extractor):
                 init_fn=lambda: vggish_init_params(seed=0),
             )
         )
-        # reference parity: processor constructed, applied only on request
+        # reference parity: processor constructed, applied only on request —
+        # --vggish_postprocess (vendored AudioSet params) or an explicit
+        # VFT_VGGISH_PCA_PARAMS path (env var implies opt-in, as before)
         pca_path = os.environ.get("VFT_VGGISH_PCA_PARAMS")
+        if pca_path is None and self.cfg.vggish_postprocess:
+            pca_path = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "weights", "data", "vggish_pca_params.npz")
         self.postprocessor = Postprocessor(pca_path) if pca_path else None
 
     @functools.cached_property

@@ -53,10 +53,18 @@ def maybe_initialize_distributed() -> bool:
         kwargs = {}
         if coord:
             kwargs["coordinator_address"] = coord
-        if os.environ.get("JAX_NUM_PROCESSES"):
-            kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
-        if os.environ.get("JAX_PROCESS_ID"):
-            kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        n_proc = os.environ.get("JAX_NUM_PROCESSES")
+        proc_id = os.environ.get("JAX_PROCESS_ID")
+        if bool(n_proc) != bool(proc_id):
+            # a half-specified pair makes initialize() fail or hang with no
+            # hint at the cause; fail fast with the fix instead
+            raise RuntimeError(
+                "JAX_NUM_PROCESSES and JAX_PROCESS_ID must be set together "
+                f"(got JAX_NUM_PROCESSES={n_proc!r}, JAX_PROCESS_ID={proc_id!r})"
+            )
+        if n_proc:
+            kwargs["num_processes"] = int(n_proc)
+            kwargs["process_id"] = int(proc_id)
         jax.distributed.initialize(**kwargs)
         return jax.process_count() > 1
     return False
@@ -177,7 +185,15 @@ class DecodePrefetcher:
 
         def drain() -> Iterator[Tuple[np.ndarray, float]]:
             while True:
-                item = slot["q"].get()
+                try:
+                    item = slot["q"].get(timeout=0.2)
+                except queue.Empty:
+                    # release()/shutdown() with a full queue can drop their
+                    # _DONE sentinel while the stopped worker never enqueues
+                    # one — without this check a late consumer blocks forever
+                    if slot["stop"].is_set() or self._stop.is_set():
+                        return
+                    continue
                 if item is self._DONE:
                     if slot["err"] is not None:
                         raise slot["err"]

@@ -1,0 +1,77 @@
+"""Extract features from videos — TPU-native CLI driver.
+
+Drop-in surface of the reference ``main.py`` (same flags), invoked via the repo's
+``main.py`` shim or the ``video-features-tpu`` console script::
+
+    python main.py --feature_type i3d --video_paths a.mp4 b.mp4 --on_extraction save_numpy
+
+Videos are embarrassingly parallel: the list is processed by the extractor, whose
+device step is jit-compiled for the local TPU mesh; multi-host jobs shard the list
+round-robin per host (``--num_devices`` governs the local mesh size).
+"""
+
+import os
+import sys
+
+from video_features_tpu.cli import parse_args
+from video_features_tpu.extractors import get_extractor
+
+
+def _honor_jax_platforms_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu python main.py ...`` work under this image.
+
+    The image's sitecustomize registers the axon TPU backend and pins
+    ``jax_platforms`` before user code runs, so the env var set by the user on the
+    command line is silently ignored unless re-applied through the config API.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception as e:
+            print(f"warning: could not apply JAX_PLATFORMS={want}: {e}", file=sys.stderr)
+
+
+def main(argv=None) -> int:
+    _honor_jax_platforms_env()
+    cfg = parse_args(argv)
+
+    # Multi-host bootstrap (DCN): must precede the first device access so every
+    # process sees the global topology; no-op on single-host jobs.
+    from video_features_tpu.parallel import maybe_initialize_distributed
+
+    if maybe_initialize_distributed():
+        import jax
+
+        print(f"multi-host job: process {jax.process_index()}/{jax.process_count()}")
+
+    extractor = get_extractor(cfg)
+    paths = extractor.video_list()
+    if not paths:
+        print("No videos to process.")
+        return 1
+
+    # Multi-host jobs: each process owns a round-robin shard of the video list
+    # (the reference's gen_file_list.py split, without the manual file juggling).
+    from video_features_tpu.parallel import shard_video_list
+
+    paths = shard_video_list(paths)
+    if not paths:
+        print("No videos assigned to this host.")
+        return 0
+
+    def progress(done, total):
+        print(f"\r[{done}/{total}] videos processed", end="", flush=True)
+
+    ok = extractor.run(paths, progress=progress)
+    print()
+    failed = len(paths) - ok
+    if failed:
+        print(f"{failed} video(s) failed (see log above)")
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
