@@ -196,11 +196,13 @@ def main() -> None:
             **kw,
         )
 
-    def record(name, timing, units_per_iter, unit, flops_per_iter):
+    def record(name, timing, units_per_iter, unit, flops_per_iter, chips=None):
         secs_per_iter, sync, iters_run = timing
         tflops = flops_per_iter / secs_per_iter / 1e12 if flops_per_iter else None
         entry = {
-            "value": round(units_per_iter / secs_per_iter / n_chips, 3),
+            # `chips`: the entry's actual mesh size when it differs from the
+            # host's device count (the flow benches pin num_devices=1)
+            "value": round(units_per_iter / secs_per_iter / (chips or n_chips), 3),
             "unit": unit,
             "sec_per_iter": round(secs_per_iter, 5),
             "host_sync_sec": round(sync, 4),
@@ -250,52 +252,64 @@ def main() -> None:
     # north-star accuracy path
     if not on_cpu:
         for flow_type in ("pwc", "raft"):
-            _log(f"i3d_flow_{flow_type}: building extractor + inputs")
-            ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type=flow_type,
-                                stack_size=64, step_size=64, clips_per_batch=1))
+            for flow_dtype in ("float32", "bfloat16"):
+                _log(f"i3d_flow_{flow_type}_{flow_dtype}: building extractor + inputs")
+                ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type=flow_type,
+                                    stack_size=64, step_size=64, clips_per_batch=1,
+                                    flow_dtype=flow_dtype))
 
-            def mk_flow(ex=ex):
-                return (ex.i3d_params["flow"],
-                        ex.runner.put(rng.integers(0, 256,
-                                                   (ex.clips_per_batch, 65, 256, 256, 3),
-                                                   dtype=np.uint8)))
+                def mk_flow(ex=ex):
+                    return (ex.i3d_params["flow"],
+                            ex.runner.put(rng.integers(
+                                0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
+                                dtype=np.uint8)))
 
-            timing = _time_step(ex._flow_step, mk_flow, iters=2)
-            record(f"i3d_flow_{flow_type}_float32", timing, ex.clips_per_batch,
-                   "clips/sec/chip", _flops_of(ex._flow_step, *mk_flow()))
+                timing = _time_step(ex._flow_step, mk_flow, iters=2)
+                record(f"i3d_flow_{flow_type}_{flow_dtype}", timing,
+                       ex.clips_per_batch, "clips/sec/chip",
+                       _flops_of(ex._flow_step, *mk_flow()))
 
     # ---- RAFT dense flow: pairs/sec at 256² (20 GRU iterations) ---------------
+    # production single-chip path: the shared-frame step (each frame encoded
+    # once); multi-device meshes use the pair-split step instead
     pairs, side = (1, 128) if on_cpu else (16, 256)
-    _log(f"raft_pairs: building extractor + inputs ({pairs} pairs × {side}²)")
-    ex = ExtractFlow(cfg("raft", batch_size=pairs))
+    for flow_dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
+        _log(f"raft_pairs_{flow_dtype}: building extractor + inputs "
+             f"({pairs} pairs × {side}²)")
+        ex = ExtractFlow(cfg("raft", batch_size=pairs, num_devices=1,
+                             flow_dtype=flow_dtype))
 
-    def mk_pairs(ex=ex):
-        fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
-        return (ex.params, ex.runner.put(fr[:-1]), ex.runner.put(fr[1:]))
+        def mk_pairs(ex=ex):
+            fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
+            return (ex.params, ex.runner.put(fr))
 
-    timing = _time_step(ex._step, mk_pairs, iters=1 if on_cpu else 6,
-                        repeats=_repeats(on_cpu))
-    record("raft_pairs_float32", timing, ex.batch_size, "pairs/sec/chip",
-           _flops_of(ex._step, *mk_pairs()))
+        timing = _time_step(ex._frames_step, mk_pairs, iters=1 if on_cpu else 6,
+                            repeats=_repeats(on_cpu))
+        record(f"raft_pairs_{flow_dtype}", timing, ex.batch_size, "pairs/sec/chip",
+               _flops_of(ex._frames_step, *mk_pairs()), chips=ex.runner.num_devices)
 
     # ---- PWC dense flow: pairs/sec at 256², xla vs pallas cost volume ---------
     # the pallas kernel's VMEM working set caps its batch (ops/pallas_corr);
     # the xla config is also run at the small batch for a like-for-like delta
-    pwc_configs = [("xla", pairs)]
+    pwc_configs = [("xla", pairs, "float32")]
     if not on_cpu:
-        pwc_configs += [("xla", 2), ("pallas", 2)]
-    for corr, b in pwc_configs:
-        _log(f"pwc_pairs_{corr}_b{b}: building extractor + inputs ({b} pairs × {side}²)")
-        ex = ExtractFlow(cfg("pwc", batch_size=b, pwc_corr=corr))
+        pwc_configs += [("xla", pairs, "bfloat16"), ("xla", 2, "float32"),
+                        ("pallas", 2, "float32")]
+    for corr, b, flow_dtype in pwc_configs:
+        _log(f"pwc_pairs_{flow_dtype}_{corr}_b{b}: building extractor + inputs "
+             f"({b} pairs × {side}²)")
+        ex = ExtractFlow(cfg("pwc", batch_size=b, pwc_corr=corr, num_devices=1,
+                             flow_dtype=flow_dtype))
 
         def mk_pwc(ex=ex):
             fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
-            return (ex.params, ex.runner.put(fr[:-1]), ex.runner.put(fr[1:]))
+            return (ex.params, ex.runner.put(fr))
 
-        timing = _time_step(ex._step, mk_pwc, iters=1 if on_cpu else 6,
+        timing = _time_step(ex._frames_step, mk_pwc, iters=1 if on_cpu else 6,
                             repeats=_repeats(on_cpu))
-        record(f"pwc_pairs_float32_{corr}_b{b}", timing, ex.batch_size, "pairs/sec/chip",
-               _flops_of(ex._step, *mk_pwc()))
+        record(f"pwc_pairs_{flow_dtype}_{corr}_b{b}", timing, ex.batch_size,
+               "pairs/sec/chip", _flops_of(ex._frames_step, *mk_pwc()),
+               chips=ex.runner.num_devices)
 
     # ---- R(2+1)D: clips/sec, 16-frame 112² slices (reference r21d geometry) ---
     if not on_cpu:
@@ -346,6 +360,103 @@ def main() -> None:
                             repeats=_repeats(on_cpu))
         record(f"resnet50_{dtype}", timing, ex.batch_size, "frames/sec/chip",
                _flops_of(ex._step, *mk_frames()))
+
+    # ---- end-to-end extract(): decode → transform → device → collect ----------
+    # The reference's real workload is whole videos through the full pipeline
+    # (SURVEY §3.1 hot loop); device-step benches above exclude decode. Stage
+    # attribution comes from the production StageClock. Methodology: each
+    # config's device programs are pre-compiled on SYNTHETIC batches (different
+    # content from the video, so the tunnel backend's (executable, args)
+    # memoization cannot serve the timed pass), then ONE timed pass runs both
+    # sample videos with fresh (real) frames.
+    if not on_cpu:
+        from video_features_tpu.utils.metrics import StageClock
+
+        videos = [os.path.join(REPO, "sample", "v_GGSY1Qvo990.mp4"),
+                  os.path.join(REPO, "sample", "v_ZNVhz7ctTq0.mp4")]
+        videos = [v for v in videos if os.path.exists(v)]
+
+        def bench_e2e(name, ex, warm_fn, feat_key, unit_key=None):
+            _log(f"{name}: compiling on synthetic batches")
+            warm_fn()
+            clock = StageClock()
+            ex.clock = clock
+            if ex.cfg.decode_workers > 1 and ex.uses_frame_stream:
+                # the pool is normally created by run(); replicate its
+                # schedule-ahead window for the direct extract() calls
+                from video_features_tpu.parallel.pipeline import DecodePrefetcher
+
+                ex._decode_pool = DecodePrefetcher(ex._open_inline,
+                                                   ex.cfg.decode_workers)
+                for v in videos:
+                    ex._decode_pool.schedule(v)
+            total_units = 0
+            t0 = time.perf_counter()
+            for v in videos:
+                try:
+                    out = ex.extract(v)
+                finally:
+                    if ex._decode_pool is not None:
+                        ex._decode_pool.release(v)
+                n = out[feat_key].shape[0]
+                total_units += n
+            wall = time.perf_counter() - t0
+            if ex._decode_pool is not None:
+                ex._decode_pool.shutdown()
+                ex._decode_pool = None
+            ex.clock = None
+            entry = {
+                "videos_per_sec": round(len(videos) / wall, 4),
+                "unit": unit_key or f"{feat_key} rows",
+                "units_per_sec": round(total_units / wall, 2),
+                "wall_sec": round(wall, 3),
+                "decode_sec": round(clock.seconds.get("decode", 0.0), 3),
+                "device_wait_sec": round(clock.seconds.get("device_wait", 0.0), 3),
+            }
+            details[name] = entry
+            _log(f"{name}: {entry['videos_per_sec']} videos/s "
+                 f"({entry['units_per_sec']} {entry['unit']}/s; decode "
+                 f"{entry['decode_sec']}s, device_wait {entry['device_wait_sec']}s "
+                 f"of {entry['wall_sec']}s)")
+
+        if videos:
+            for workers in (1, 4):
+                ex = ExtractResNet50(cfg("resnet50", batch_size=64,
+                                         decode_workers=workers))
+                bench_e2e(
+                    f"e2e_resnet50_float32_w{workers}", ex,
+                    lambda ex=ex: _force(ex._step(ex.params, ex.runner.put(
+                        rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
+                                     dtype=np.uint8)))),
+                    "resnet50", "frames")
+
+            # flagship two-stream I3D at the reference default (flow via PWC);
+            # sample videos decode to 256×341 after the 256-edge resize
+            ex = ExtractI3D(cfg("i3d", streams=("rgb", "flow"), flow_type="pwc",
+                                stack_size=64, step_size=64, clips_per_batch=1))
+
+            def warm_i3d(ex=ex):
+                stacks = ex.runner.put(rng.integers(
+                    0, 256, (ex.clips_per_batch, 65, 256, 341, 3), dtype=np.uint8))
+                _force(ex._rgb_step(ex.i3d_params["rgb"], stacks))
+                _force(ex._flow_step(ex.i3d_params["flow"], stacks))
+
+            bench_e2e("e2e_i3d_two_stream_pwc_float32_w1", ex, warm_i3d,
+                      "rgb", "stacks")
+
+            def warm_raft(ex):
+                # both sample geometries: v1 decodes 240x320, v2 360x480 — a
+                # miss would put a 20-100 s tunnel compile inside the timed pass
+                for h, w in ((240, 320), (360, 480)):
+                    _force(ex._frames_step(ex.params, ex.runner.put(
+                        rng.uniform(0, 255, (ex.batch_size + 1, h, w, 3))
+                        .astype(np.float32))))
+
+            for workers in (1, 4):
+                ex = ExtractFlow(cfg("raft", batch_size=16, num_devices=1,
+                                     decode_workers=workers))
+                bench_e2e(f"e2e_raft_float32_w{workers}", ex,
+                          lambda ex=ex: warm_raft(ex), "raft", "pairs")
 
     # ---- headline line --------------------------------------------------------
     baseline = 0.0

@@ -1,0 +1,175 @@
+"""Production ``extract()`` vs FROZEN golden fixtures (tests/goldens/*.npz).
+
+Unlike the live-oracle parity tests (which recompute the torch mirror at test
+time and thus drift in lockstep with shared-constant edits or torch upgrades),
+these compare against arrays frozen at generation time by
+``tools/make_goldens.py`` — the suite fails if any feature drifts from the
+committed values, whatever the cause.
+
+Each fixture stores a weight fingerprint; if the deterministically re-seeded
+state dict no longer matches it, the golden is STALE (torch RNG changed) and
+the test fails with a regeneration hint instead of a misleading numeric diff.
+
+Decode determinism: extraction runs with ``use_ffmpeg="never"`` so hosts with
+and without ffmpeg resample fps identically (the fixtures were generated that
+way). cv2/PIL version bumps that change decoded pixels require regeneration —
+that is the point of a frozen fixture.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # full-video extract() on CPU: minutes
+
+import torch  # noqa: E402
+
+from video_features_tpu.config import ExtractionConfig  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from make_goldens import SAMPLES, fingerprint, state_dict_for, synth_wav  # noqa: E402
+
+
+def _load(name):
+    path = os.path.join(GOLDEN_DIR, f"{name}.npz")
+    if not os.path.exists(path):
+        pytest.skip(f"golden fixture {name} not generated")
+    return dict(np.load(path))
+
+
+def _check_fp(golden, key, model):
+    sd = state_dict_for(model)
+    fp = fingerprint(sd)
+    if not np.allclose(fp, golden[key], rtol=1e-10):
+        pytest.fail(
+            f"STALE GOLDEN: deterministic weights for {model} no longer match the "
+            f"fingerprint recorded in the fixture (torch RNG changed?). Regenerate "
+            f"with: JAX_PLATFORMS=cpu python tools/make_goldens.py"
+        )
+    return sd
+
+
+def _ckpt_dir(tmp_path, monkeypatch, **models):
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    for fname, sd in models.items():
+        torch.save(sd, d / f"{fname}.pt")
+    monkeypatch.setenv("VFT_CHECKPOINT_DIR", str(d))
+    monkeypatch.delenv("VFT_ALLOW_RANDOM_WEIGHTS", raising=False)
+    return d
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("use_ffmpeg", "never")
+    kw.setdefault("num_devices", 1)
+    return ExtractionConfig(
+        output_path=str(tmp_path / "o"), tmp_path=str(tmp_path / "t"), **kw
+    )
+
+
+@pytest.mark.parametrize("vid", ["v1", "v2"])
+def test_resnet50_frozen(vid, tmp_path, monkeypatch):
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+
+    g = _load(f"resnet50_{vid}")
+    sd = _check_fp(g, "fp", "resnet50")
+    _ckpt_dir(tmp_path, monkeypatch, resnet50=sd)
+    ex = ExtractResNet50(_cfg(tmp_path, feature_type="resnet50", batch_size=8,
+                              extraction_fps=int(g["cfg_extraction_fps"])))
+    out = ex.extract(SAMPLES[vid])["resnet50"][:: int(g["stride0"])]
+    ref = g["features"]
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3 * np.abs(ref).max())
+
+
+@pytest.mark.parametrize("vid", ["v1", "v2"])
+def test_r21d_frozen(vid, tmp_path, monkeypatch):
+    from video_features_tpu.extractors.r21d import ExtractR21D
+
+    g = _load(f"r21d_{vid}")
+    sd = _check_fp(g, "fp", "r21d")
+    _ckpt_dir(tmp_path, monkeypatch, r2plus1d_18=sd)
+    ex = ExtractR21D(_cfg(tmp_path, feature_type="r21d_rgb"))
+    out = ex.extract(SAMPLES[vid])["r21d_rgb"]
+    ref = g["features"]
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3 * np.abs(ref).max())
+
+
+@pytest.mark.parametrize("kind,vid", [("raft", "v1"), ("raft", "v2"),
+                                      ("pwc", "v1"), ("pwc", "v2")])
+def test_flow_frozen(kind, vid, tmp_path, monkeypatch):
+    from video_features_tpu.extractors.flow import ExtractFlow
+
+    g = _load(f"{kind}_{vid}")
+    sd = _check_fp(g, "fp", kind)
+    _ckpt_dir(tmp_path, monkeypatch, **{f"{kind}-sintel": sd})
+    ex = ExtractFlow(_cfg(tmp_path, feature_type=kind, batch_size=8,
+                          side_size=int(g["cfg_side_size"]),
+                          extraction_fps=int(g["cfg_extraction_fps"])))
+    out = ex.extract(SAMPLES[vid])[kind]
+    s0, shw = int(g["stride0"]), int(g["stride_hw"])
+    out = out[::s0, :, ::shw, ::shw]
+    ref = g["features"]
+    assert out.shape == ref.shape
+    # RAFT's 20 recurrent iterations amplify last-ulp backend differences
+    # (see tests/test_parallel.py tolerance note); PWC is single-pass
+    tol = 5e-2 if kind == "raft" else 1e-3
+    np.testing.assert_allclose(out, ref, rtol=tol, atol=tol * np.abs(ref).max())
+
+
+@pytest.mark.parametrize("vid", ["v1", "v2"])
+def test_i3d_two_stream_frozen(vid, tmp_path, monkeypatch):
+    from video_features_tpu.extractors.i3d import ExtractI3D
+
+    g = _load(f"i3d_{vid}")
+    sd_rgb = _check_fp(g, "fp_rgb", "i3d_rgb")
+    sd_flow = _check_fp(g, "fp_flow", "i3d_flow")
+    sd_pwc = _check_fp(g, "fp_pwc", "pwc")
+    _ckpt_dir(tmp_path, monkeypatch, i3d_rgb=sd_rgb, i3d_flow=sd_flow,
+              **{"pwc-sintel": sd_pwc})
+    ex = ExtractI3D(_cfg(tmp_path, feature_type="i3d", stack_size=16, step_size=16,
+                         flow_type="pwc",
+                         extraction_fps=int(g["cfg_extraction_fps"])))
+    out = ex.extract(SAMPLES[vid])
+    for stream in ("rgb", "flow"):
+        ref = g[stream]
+        assert out[stream].shape == ref.shape
+        np.testing.assert_allclose(
+            out[stream], ref, rtol=1e-3, atol=1e-3 * np.abs(ref).max(),
+            err_msg=f"{stream} stream drifted from the frozen golden")
+
+
+def test_vggish_frozen(tmp_path, monkeypatch):
+    from video_features_tpu.extractors.vggish import ExtractVGGish
+    from video_features_tpu.models.vggish import vggish_init_params
+    from video_features_tpu.weights.store import save_params_npz
+
+    g = _load("vggish_tone")
+    params = vggish_init_params(seed=3)
+    flat_sum = np.float64(sum(float(leaf.sum()) for mod in params.values()
+                              for leaf in mod.values()))
+    flat_abs = np.float64(sum(float(np.abs(leaf).sum()) for mod in params.values()
+                              for leaf in mod.values()))
+    n = sum(leaf.size for mod in params.values() for leaf in mod.values())
+    if not np.allclose(np.array([flat_sum, flat_abs, n]), g["fp"], rtol=1e-10):
+        pytest.fail("STALE GOLDEN: vggish deterministic params changed; regenerate "
+                    "with tools/make_goldens.py")
+
+    d = tmp_path / "ckpts"
+    d.mkdir()
+    save_params_npz(str(d / "vggish.npz"), params)
+    monkeypatch.setenv("VFT_CHECKPOINT_DIR", str(d))
+    monkeypatch.delenv("VFT_ALLOW_RANDOM_WEIGHTS", raising=False)
+
+    wav = str(tmp_path / "tone.wav")
+    synth_wav(wav)
+    ex = ExtractVGGish(_cfg(tmp_path, feature_type="vggish"))
+    out = ex.extract(wav)["vggish"]
+    ref = g["features"]
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3 * np.abs(ref).max())

@@ -12,8 +12,6 @@ import itertools
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow  # whole-model parity: minutes on CPU
-
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.io.video import open_video
 

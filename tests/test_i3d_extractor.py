@@ -7,6 +7,9 @@ identical to the 64-frame default.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute on CPU: whole-model parity / full-video extract
+
+
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.extractors.i3d import ExtractI3D
 

@@ -5,6 +5,9 @@ correlation.py:44-112, corr.py:12-91)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute on CPU: whole-model parity / full-video extract
+
+
 import jax.numpy as jnp
 
 from video_features_tpu.ops.pallas_corr import corr81, corr81_pallas, corr81_xla

@@ -9,6 +9,9 @@ the TPU answer to testing multi-chip topologies without hardware (SURVEY.md §4)
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute on CPU: whole-model parity / full-video extract
+
+
 from video_features_tpu.config import ExtractionConfig
 
 

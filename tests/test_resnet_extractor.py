@@ -3,6 +3,9 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute on CPU: whole-model parity / full-video extract
+
+
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.extractors.resnet import ExtractResNet50
 

@@ -113,6 +113,23 @@ def main():
 
     time_fn("full", full, lambda: (params, frames(), frames()))
 
+    # --- shared-frame forward: b pairs from b+1 frames, pyramid once/frame ---
+    def frames_plus1():
+        return jnp.asarray(
+            rng.uniform(0, 255, (b + 1, side, side, 3)).astype(np.float32))
+
+    @jax.jit
+    def full_frames(p, fr):
+        return P.pwc_forward_frames(p, fr)
+
+    time_fn("full_frames", full_frames, lambda: (params, frames_plus1()))
+
+    @jax.jit
+    def full_frames_bf16(p, fr):
+        return P.pwc_forward_frames(p, fr, dtype=jnp.bfloat16)
+
+    time_fn("full_frames_bf16", full_frames_bf16, lambda: (params, frames_plus1()))
+
 
 if __name__ == "__main__":
     main()

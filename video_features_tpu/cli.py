@@ -59,6 +59,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="devices in the data-parallel mesh (default: all local)")
     parser.add_argument("--resume", action="store_true", default=False,
                         help="skip videos recorded in the output done-manifest")
+    parser.add_argument("--flow_dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="RAFT/PWC conv + correlation storage dtype; "
+                             "correlation ACCUMULATION and coordinate math stay "
+                             "fp32 either way (float32 = reference parity; "
+                             "measured bf16 drift in tests/test_flow_bf16.py)")
     parser.add_argument("--raft_corr", choices=["volume", "volume_gather", "on_demand"],
                         default="volume",
                         help="RAFT correlation: materialized pyramid with MXU matmul "
@@ -74,6 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "size (multiple of 8) so a mixed-resolution corpus "
                              "compiles one program per bucket, not per geometry; "
                              "off = reference-exact /8 padding only")
+    parser.add_argument("--use_ffmpeg", choices=["auto", "always", "never"],
+                        default="auto",
+                        help="--extraction_fps backend: ffmpeg re-encode when "
+                             "installed (auto; reference parity) or the native "
+                             "vf_fps-semantics sampler (never; host-independent)")
     parser.add_argument("--vggish_postprocess", action="store_true", default=False,
                         help="apply the AudioSet PCA-whiten + uint8 quantize "
                              "postprocessor to VGGish embeddings (vendored params; "

@@ -62,6 +62,12 @@ class ExtractionConfig:
     # thread-per-GPU; SPMD centralizes devices, so decode streams are explicit).
     # 1 = inline decode. Frame-stream models only (resnet50, raft, pwc, i3d).
     decode_workers: int = 1
+    # Flow-net (RAFT/PWC) conv compute + correlation storage dtype, independent
+    # of `dtype` (which governs the feature networks): bfloat16 halves flow-net
+    # HBM traffic and MXU passes; correlation ACCUMULATION and coordinate math
+    # stay fp32 either way. float32 (default) is the reference-parity path.
+    # Measured bf16 drift: tests/test_flow_bf16.py and docs/architecture.md.
+    flow_dtype: str = "float32"
     # RAFT correlation: "volume" materializes the all-pairs pyramid (reference
     # default); "on_demand" is the alt_cuda_corr equivalent — O(H·W·D) memory.
     raft_corr: str = "volume"
@@ -74,6 +80,12 @@ class ExtractionConfig:
     # compiles cost 20-100s each). Numerics caveat: like the reference's own /8
     # pad, edge padding perturbs flow near borders — parity runs leave it off.
     shape_bucket: Optional[int] = None
+    # --extraction_fps resampling backend: "auto" re-encodes through ffmpeg
+    # when installed (exact reference parity, utils/utils.py:147-169) and
+    # falls back to the native vf_fps-semantics sampler; "never" forces the
+    # native sampler (deterministic across hosts with/without ffmpeg — the
+    # frozen-golden tests pin this); "always" errors without ffmpeg.
+    use_ffmpeg: str = "auto"
     # VGGish: apply the AudioSet PCA-whiten + uint8 quantize postprocessor
     # (vendored params). Off by default — the reference constructs the
     # postprocessor but never applies it (extract_vggish.py:57,104-116).
@@ -112,6 +124,8 @@ class ExtractionConfig:
             raise ValueError("batch_size must be >= 1")
         if self.clips_per_batch < 1:
             raise ValueError("clips_per_batch must be >= 1")
+        if self.flow_dtype not in ("float32", "bfloat16"):
+            raise ValueError("flow_dtype must be float32|bfloat16")
         if self.raft_corr not in ("volume", "volume_gather", "on_demand"):
             raise ValueError("raft_corr must be volume|volume_gather|on_demand")
         if self.pwc_corr not in ("xla", "pallas"):
@@ -120,6 +134,8 @@ class ExtractionConfig:
             raise ValueError("matmul_precision must be default|high|highest")
         if self.decode_workers < 1:
             raise ValueError("decode_workers must be >= 1")
+        if self.use_ffmpeg not in ("auto", "always", "never"):
+            raise ValueError("use_ffmpeg must be auto|always|never")
         if self.shape_bucket is not None and (
             self.shape_bucket < 8 or self.shape_bucket % 8
         ):

@@ -72,6 +72,7 @@ class Extractor(abc.ABC):
             extraction_fps=self.cfg.extraction_fps,
             tmp_path=self.tmp_dir,
             keep_tmp_files=self.cfg.keep_tmp_files,
+            use_ffmpeg=self.cfg.use_ffmpeg,
             transform=self._host_transform,
         )
 

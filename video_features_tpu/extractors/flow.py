@@ -25,7 +25,13 @@ from typing import Dict, List
 
 import numpy as np
 
-from ..models.raft import pad_to_multiple, raft_forward, raft_init_params, unpad
+from ..models.raft import (
+    pad_to_multiple,
+    raft_forward,
+    raft_forward_frames,
+    raft_init_params,
+    unpad,
+)
 from ..ops.image import pil_edge_resize
 from ..weights.convert_torch import convert_raft
 from ..weights.store import resolve_params
@@ -39,10 +45,13 @@ class ExtractFlow(Extractor):
 
     def __init__(self, cfg):
         super().__init__(cfg)
+        import jax.numpy as jnp
+
         # pairs per device step, rounded to a multiple of the mesh size so the
         # sharded pair axis divides evenly (tail pairs repeat the last frame)
         self.batch_size = self.runner.device_batch(cfg.batch_size)
         self._viz_counter = 0  # --show_pred PNG fallback numbering
+        flow_dtype = jnp.bfloat16 if cfg.flow_dtype == "bfloat16" else jnp.float32
         if self.feature_type == "raft":
             self.params = self.runner.put_replicated(
                 resolve_params(
@@ -51,10 +60,13 @@ class ExtractFlow(Extractor):
                     init_fn=lambda: raft_init_params(seed=0),
                 )
             )
-            self._forward = functools.partial(raft_forward, corr_impl=cfg.raft_corr)
+            self._forward = functools.partial(
+                raft_forward, corr_impl=cfg.raft_corr, dtype=flow_dtype)
+            self._forward_frames = functools.partial(
+                raft_forward_frames, corr_impl=cfg.raft_corr, dtype=flow_dtype)
             self._pads_input = True
         elif self.feature_type == "pwc":
-            from ..models.pwc import pwc_forward, pwc_init_params
+            from ..models.pwc import pwc_forward, pwc_forward_frames, pwc_init_params
             from ..weights.convert_torch import convert_pwc
 
             self.params = self.runner.put_replicated(
@@ -64,7 +76,10 @@ class ExtractFlow(Extractor):
                     init_fn=lambda: pwc_init_params(seed=0),
                 )
             )
-            self._forward = functools.partial(pwc_forward, corr_impl=cfg.pwc_corr)
+            self._forward = functools.partial(
+                pwc_forward, corr_impl=cfg.pwc_corr, dtype=flow_dtype)
+            self._forward_frames = functools.partial(
+                pwc_forward_frames, corr_impl=cfg.pwc_corr, dtype=flow_dtype)
             self._pads_input = False
         else:
             raise ValueError(f"not a flow feature type: {self.feature_type}")
@@ -80,6 +95,18 @@ class ExtractFlow(Extractor):
             return fwd(params, prev, nxt)
 
         return self.runner.jit(step, n_batch_args=2)
+
+    @functools.cached_property
+    def _frames_step(self):
+        fwd = self._forward_frames
+
+        # single-device meshes skip the pair split: (B+1) frames in, each frame
+        # encoded once (the pair-split step encodes interior frames twice —
+        # the encoder/pyramid is the flow nets' dominant stage)
+        def step(params, frames):  # (B+1, H, W, 3) float32
+            return fwd(params, frames)
+
+        return self.runner.jit(step)
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.cfg.side_size, self.cfg.resize_to_smaller_edge)
@@ -99,9 +126,15 @@ class ExtractFlow(Extractor):
             frames, pads = pad_to_multiple(frames, self.cfg.shape_bucket)
         elif self._pads_input:
             frames, pads = pad_to_multiple(frames, 8)
-        prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
-        nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
-        flow = self._wait(self._step(self.params, prev, nxt))
+        if self.runner.num_devices == 1:
+            # shared-frame step: every frame encoded once (B+1 frames don't
+            # shard evenly over a multi-device mesh, so this is single-chip)
+            dev = self.runner.put(np.ascontiguousarray(frames))
+            flow = self._wait(self._frames_step(self.params, dev))
+        else:
+            prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
+            nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
+            flow = self._wait(self._step(self.params, prev, nxt))
         if pads is not None:
             flow = unpad(flow, pads)
         # NHWC → reference byte layout (B, 2, H, W)

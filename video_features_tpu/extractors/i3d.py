@@ -21,8 +21,10 @@ TPU design (vs the reference's one-stack-at-a-time GPU loop, ``:139-169``):
 - ``clips_per_batch`` stacks are batched into each jitted call (the reference has
   no clip batching at all) and the batch axis is sharded across the device mesh;
 - host decode/stacking overlaps device compute via the prefetcher;
-- ``--dtype bfloat16`` runs the I3D conv stacks in bf16 on the MXU (the flow nets
-  stay fp32 — iterative flow refinement is precision-sensitive).
+- ``--dtype bfloat16`` runs the I3D conv stacks in bf16 on the MXU; the flow
+  nets have their own ``--flow_dtype`` knob (default fp32 for reference parity;
+  bf16 keeps correlation accumulation and coordinate math fp32 — measured
+  drift in tests/test_flow_bf16.py).
 """
 
 from __future__ import annotations
@@ -37,8 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from ..models.i3d import I3D, i3d_preprocess_flow, i3d_preprocess_rgb
-from ..models.pwc import pwc_forward, pwc_init_params
-from ..models.raft import raft_forward, raft_init_params
+from ..models.pwc import pwc_forward_frames, pwc_init_params
+from ..models.raft import raft_forward_frames, raft_init_params
 from ..ops.image import pil_edge_resize
 from ..parallel import prefetch_to_device
 from ..utils.labels import show_predictions_on_dataset
@@ -141,28 +143,32 @@ class ExtractI3D(Extractor):
         flow_params = self.flow_params
         with_pred = self.cfg.show_pred
         dtype = self.dtype
+        flow_dtype = (jnp.bfloat16 if self.cfg.flow_dtype == "bfloat16"
+                      else jnp.float32)
         raft_corr = self.cfg.raft_corr
         pwc_corr = self.cfg.pwc_corr
 
         def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
             n, sp1, h, w, _c = stacks_u8.shape
-            s = sp1 - 1
             frames = stacks_u8.astype(jnp.float32)
-            # all N·S consecutive pairs in one flow-net call (flat batch keeps the
-            # mesh-sharded clip axis leading: each device flows its own clips)
-            prev = frames[:, :-1].reshape(n * s, h, w, 3)
-            nxt = frames[:, 1:].reshape(n * s, h, w, 3)
+            # shared-frame flow: each frame is encoded ONCE and the N·S
+            # consecutive pairs are formed from the per-frame features (the
+            # encoder/pyramid is the flow nets' dominant stage; pair-split
+            # batches would encode every interior frame twice). The clip axis
+            # stays leading and mesh-sharded: each device flows its own clips.
             if flow_type == "raft":
                 # replicate-pad to /8 and, like the reference, never unpad: the
                 # 224 center crop below runs on the padded flow
                 ph, pw = (8 - h % 8) % 8, (8 - w % 8) % 8
-                pads = ((0, 0), (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
-                flow = raft_forward(
-                    flow_params, jnp.pad(prev, pads, mode="edge"),
-                    jnp.pad(nxt, pads, mode="edge"), corr_impl=raft_corr)
+                pads = ((0, 0), (0, 0),
+                        (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
+                flow = raft_forward_frames(
+                    flow_params, jnp.pad(frames, pads, mode="edge"),
+                    corr_impl=raft_corr, dtype=flow_dtype)
             else:
-                flow = pwc_forward(flow_params, prev, nxt, corr_impl=pwc_corr)
-            flow = flow.reshape((n, s) + flow.shape[1:])  # (N, S, Hp, Wp, 2)
+                flow = pwc_forward_frames(flow_params, frames,
+                                          corr_impl=pwc_corr, dtype=flow_dtype)
+            # flow: (N, S, Hp, Wp, 2)
             x = i3d_preprocess_flow(_center_crop_nhwc(flow, CROP_SIZE), dtype=dtype)
             feats = model.apply({"params": params}, x, features=True)
             if with_pred:

@@ -93,35 +93,87 @@ def _refiner(p: Dict, feat: jnp.ndarray) -> jnp.ndarray:
     return conv2d(p["12"], x, 1, 1)
 
 
-def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
-                corr_impl: str = "xla") -> jnp.ndarray:
-    """Flow frame1→frame2. Inputs (B, H, W, 3) float RGB [0, 255], any size.
-    Returns (B, H, W, 2) flow in input-resolution pixels.
+def _preprocess(image: jnp.ndarray, h64: int, w64: int) -> jnp.ndarray:
+    """RGB [0,255] → BGR /255 (pwc_net.py:230) resized to the /64 grid."""
+    x = image[..., ::-1].astype(jnp.float32) / 255.0
+    if (h64, w64) != image.shape[-3:-1]:
+        x = resize_bilinear_torch(x, h64, w64)
+    return x
 
-    ``corr_impl``: cost-volume implementation (``xla`` | ``pallas``), see
-    :mod:`video_features_tpu.ops.pallas_corr`."""
-    b, h, w, _ = image1.shape
-    x1 = image1[..., ::-1].astype(jnp.float32) / 255.0  # RGB → BGR (pwc_net.py:230)
-    x2 = image2[..., ::-1].astype(jnp.float32) / 255.0
 
-    h64 = int(math.floor(math.ceil(h / 64.0) * 64.0))
-    w64 = int(math.floor(math.ceil(w / 64.0) * 64.0))
-    if (h64, w64) != (h, w):
-        x1 = resize_bilinear_torch(x1, h64, w64)
-        x2 = resize_bilinear_torch(x2, h64, w64)
-
-    pyr1 = _pyramid(params["moduleExtractor"], x1)
-    pyr2 = _pyramid(params["moduleExtractor"], x2)
-
+def _decode(params: Dict, pyr1, pyr2, h: int, w: int, h64: int, w64: int,
+            corr_impl: str) -> jnp.ndarray:
+    """Coarse-to-fine decoders + refiner + output scaling (pwc_net.py:241-261)."""
     est = None
     for level in (6, 5, 4, 3, 2):
         est = _decoder(params[LEVEL_NAMES[level]], level,
                        pyr1[level - 1], pyr2[level - 1], est, corr_impl)
 
     flow = est["flow"] + _refiner(params["moduleRefiner"]["moduleMain"], est["feat"])
-    flow = 20.0 * resize_bilinear_torch(flow, h, w)
+    flow = 20.0 * resize_bilinear_torch(flow.astype(jnp.float32), h, w)
     scale = jnp.asarray([w / w64, h / h64], jnp.float32)
     return flow * scale
+
+
+def _grid64(h: int, w: int) -> Tuple[int, int]:
+    return (int(math.floor(math.ceil(h / 64.0) * 64.0)),
+            int(math.floor(math.ceil(w / 64.0) * 64.0)))
+
+
+def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
+                corr_impl: str = "xla", dtype=jnp.float32) -> jnp.ndarray:
+    """Flow frame1→frame2. Inputs (B, H, W, 3) float RGB [0, 255], any size.
+    Returns (B, H, W, 2) float32 flow in input-resolution pixels.
+
+    ``corr_impl``: cost-volume implementation (``xla`` | ``pallas``), see
+    :mod:`video_features_tpu.ops.pallas_corr`. ``dtype``: conv compute dtype —
+    ``jnp.bfloat16`` halves HBM traffic and doubles MXU rate; precision-
+    sensitive spots (cost-volume accumulation, warp coordinates, final resize/
+    scaling) stay fp32 regardless. Measured drift vs fp32 is recorded in
+    ``tests/test_flow_bf16.py`` and docs/architecture.md."""
+    b, h, w, _ = image1.shape
+    h64, w64 = _grid64(h, w)
+    x1 = _preprocess(image1, h64, w64).astype(dtype)
+    x2 = _preprocess(image2, h64, w64).astype(dtype)
+    pyr1 = _pyramid(params["moduleExtractor"], x1)
+    pyr2 = _pyramid(params["moduleExtractor"], x2)
+    return _decode(params, pyr1, pyr2, h, w, h64, w64, corr_impl)
+
+
+def pwc_forward_frames(params: Dict, frames: jnp.ndarray,
+                       corr_impl: str = "xla", dtype=jnp.float32) -> jnp.ndarray:
+    """Flow for all consecutive frame pairs, sharing per-frame features.
+
+    ``frames``: (F, H, W, 3) → (F−1, H, W, 2), or a clip batch (N, F, H, W, 3)
+    → (N, F−1, H, W, 2) — pairs never cross clip boundaries.
+
+    TPU-first formulation of the reference's pair loop: the feature pyramid —
+    PWC's dominant stage (small-channel convs at 128²/64², tools/profile_pwc.py)
+    — is computed ONCE per frame (clips flattened into the conv batch axis) and
+    pairs are formed by slicing the shared per-frame features, instead of
+    re-encoding ``frames[:-1]`` and ``frames[1:]`` separately (which encodes
+    every interior frame twice). Numerics are identical to :func:`pwc_forward`
+    on the split pair batches — per-sample conv arithmetic does not depend on
+    its batch neighbors.
+    """
+    lead = frames.shape[:-3]  # (F,) or (N, F)
+    n = int(np.prod(lead[:-1], dtype=np.int64)) if len(lead) > 1 else 1
+    f = lead[-1]
+    h, w = frames.shape[-3:-1]
+    h64, w64 = _grid64(h, w)
+    flat = _preprocess(frames.reshape((n * f, h, w, 3)), h64, w64).astype(dtype)
+    pyr = _pyramid(params["moduleExtractor"], flat)
+
+    def pairs(p, keep_first: bool):
+        nf, ph, pw, c = p.shape
+        p = p.reshape(n, f, ph, pw, c)
+        p = p[:, :-1] if keep_first else p[:, 1:]
+        return p.reshape(n * (f - 1), ph, pw, c)
+
+    pyr1 = tuple(pairs(p, True) for p in pyr)
+    pyr2 = tuple(pairs(p, False) for p in pyr)
+    flow = _decode(params, pyr1, pyr2, h, w, h64, w64, corr_impl)
+    return flow.reshape(lead[:-1] + (f - 1, h, w, 2))
 
 
 # ---------------------------------------------------------------------------

@@ -75,15 +75,21 @@ def _encoder(p: dict, x: jnp.ndarray, kind: str) -> jnp.ndarray:
     return conv2d(p["conv2"], x, 1, 0)
 
 
-def _build_pyramid(f1: jnp.ndarray, f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
-    """All-pairs correlation volume pooled over target resolution (corr.py:12-27)."""
+def _build_pyramid(f1: jnp.ndarray, f2: jnp.ndarray,
+                   dtype=jnp.float32) -> Tuple[jnp.ndarray, ...]:
+    """All-pairs correlation volume pooled over target resolution (corr.py:12-27).
+
+    ``dtype=bfloat16`` stores the (H·W)² volume in bf16 — half the HBM for the
+    framework's largest tensor and half the lookup read traffic; the einsum
+    still accumulates in fp32 before the cast.
+    """
     b, h, w, d = f1.shape
     corr = jnp.einsum("bijc,bklc->bijkl", f1.astype(jnp.float32), f2.astype(jnp.float32))
-    corr = corr / math.sqrt(d)
+    corr = (corr / math.sqrt(d)).astype(dtype)
     corr = corr.reshape(b * h * w, h, w, 1)
     pyramid = [corr]
     for _ in range(CORR_LEVELS - 1):
-        corr = avg_pool2d(corr, 2, 2)
+        corr = avg_pool2d(corr, 2, 2)  # fp32 accumulation, cast back inside
         pyramid.append(corr)
     return tuple(pyramid)
 
@@ -127,8 +133,8 @@ def _combine_window(patch: jnp.ndarray, fx: jnp.ndarray, fy: jnp.ndarray) -> jnp
     delta-grid axis swap (corr.py:37-43) that the update-block weights were
     trained against.
     """
-    fx = fx[..., None, None]
-    fy = fy[..., None, None]
+    fx = fx.astype(patch.dtype)[..., None, None]  # keep bf16 paths bf16 (a
+    fy = fy.astype(patch.dtype)[..., None, None]  # fp32 fraction would promote)
     v = (
         (1 - fy) * (1 - fx) * patch[..., :-1, :-1]
         + (1 - fy) * fx * patch[..., :-1, 1:]
@@ -170,7 +176,7 @@ def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
         if hi == 0 or wi == 0:
             # tiny inputs can pool a pyramid level away entirely; every tap is
             # out of bounds → zeros (the per-corner mask semantics)
-            out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), jnp.float32))
+            out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), corr.dtype))
             continue
         ix, iy, fx, fy = _int_window((coords / 2**i).reshape(n, 2))
         if impl == "matmul":
@@ -179,15 +185,21 @@ def _lookup(pyramid, coords: jnp.ndarray, impl: str = "matmul") -> jnp.ndarray:
             # zero-padding semantics (grid_sample padding_mode='zeros')
             sy = (iy[:, :, None] == jnp.arange(hi, dtype=jnp.int32)[None, None, :])
             sx = (ix[:, :, None] == jnp.arange(wi, dtype=jnp.int32)[None, None, :])
-            # HIGHEST: selection against 0/1 is exact in fp32 accumulation, so
-            # this lowering is bit-identical to the gather path even when the
-            # surrounding convs run default (bf16-pass) precision; the extra
-            # matmul cost is noise (~2% of the step's FLOPs)
+            # fp32 volume: HIGHEST — selection against 0/1 has one nonzero
+            # product per output, so the lowering is bit-identical to the
+            # gather path even when surrounding convs run default precision.
+            # bf16 volume (flow_dtype bf16): default precision — a one-hot
+            # selection has no accumulation error at ANY precision, only the
+            # value rounding the bf16 volume already paid, and the MXU runs
+            # single-pass instead of the 6-pass fp32 sequence (the lookup is
+            # 70% of the fp32 step: 77.7 of 111 ms at b16·256²,
+            # tools/profile_raft.py).
+            prec = (lax.Precision.HIGHEST if corr.dtype == jnp.float32
+                    else lax.Precision.DEFAULT)
             rows = jnp.einsum("npi,nij->npj", sy.astype(corr.dtype),
-                              corr.reshape(n, hi, wi),
-                              precision=lax.Precision.HIGHEST)
+                              corr.reshape(n, hi, wi), precision=prec)
             patch = jnp.einsum("npj,nqj->npq", rows, sx.astype(corr.dtype),
-                               precision=lax.Precision.HIGHEST)
+                               precision=prec)
         elif impl == "gather":
             idx, mask = _tap_index_mask(ix, iy, hi, wi)
             patch = jnp.take_along_axis(corr.reshape(n, hi * wi),
@@ -261,11 +273,24 @@ def _motion_encoder(p: dict, flow: jnp.ndarray, corr: jnp.ndarray) -> jnp.ndarra
 
 
 def _sep_conv_gru(p: dict, h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
-    """Separable ConvGRU: a 1×5 pass then a 5×1 pass (update.py:37-64)."""
+    """Separable ConvGRU: a 1×5 pass then a 5×1 pass (update.py:37-64).
+
+    MXU shaping: ``convz``/``convr`` consume the same ``hx`` input, so their
+    kernels are concatenated along the output-channel axis into ONE conv per
+    direction (2 convs per pass instead of 3; the checkpoint keeps the original
+    per-gate names — fusion happens here, where the concat is loop-invariant
+    and XLA hoists it out of the scan). Bitwise identical to separate convs:
+    each output channel's contraction is unchanged.
+    """
     for suffix, pad in (("1", (0, 2)), ("2", (2, 0))):
         hx = jnp.concatenate([h, x], -1)
-        z = jax.nn.sigmoid(conv2d(p[f"convz{suffix}"], hx, 1, pad))
-        r = jax.nn.sigmoid(conv2d(p[f"convr{suffix}"], hx, 1, pad))
+        pz, pr = p[f"convz{suffix}"], p[f"convr{suffix}"]
+        zr = conv2d(
+            {"kernel": jnp.concatenate([pz["kernel"], pr["kernel"]], -1),
+             "bias": jnp.concatenate([pz["bias"], pr["bias"]], -1)},
+            hx, 1, pad)
+        z = jax.nn.sigmoid(zr[..., :HIDDEN_DIM])
+        r = jax.nn.sigmoid(zr[..., HIDDEN_DIM:])
         q = jnp.tanh(conv2d(p[f"convq{suffix}"], jnp.concatenate([r * h, x], -1), 1, pad))
         h = (1 - z) * h + z * q
     return h
@@ -276,7 +301,7 @@ def _convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
     from ..ops.nnf import extract_patches_3x3
 
     b, h, w, _ = flow.shape
-    m = mask.reshape(b, h, w, 9, 8, 8)
+    m = mask.astype(jnp.float32).reshape(b, h, w, 9, 8, 8)
     m = jax.nn.softmax(m, axis=3)
     patches = extract_patches_3x3(8.0 * flow)  # (B, H, W, 9, 2)
     up = jnp.einsum("bhwkij,bhwkc->bhwijc", m, patches)
@@ -285,7 +310,7 @@ def _convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
                  iters: int = ITERS, taps: Dict = None,
-                 corr_impl: str = "volume") -> jnp.ndarray:
+                 corr_impl: str = "volume", dtype=jnp.float32) -> jnp.ndarray:
     """Flow from frame1 to frame2. Inputs (B, H, W, 3) float RGB in [0, 255],
     H and W divisible by 8. Returns (B, H, W, 2) flow in pixels (u, v).
 
@@ -301,26 +326,85 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     ``taps``: debug-only dict filled with per-stage activations (fnet/cnet/corr/
     per-iteration flow) for the layer-diff parity harness (tools/layer_diff.py);
     tapping unrolls the update loop in Python instead of ``lax.scan``.
+
+    ``dtype``: conv compute dtype. ``jnp.bfloat16`` runs encoders/GRU convs in
+    bf16 and STORES the correlation pyramid in bf16 (fp32-accumulated before
+    the cast; halves the framework's largest tensor) with the window lookup at
+    default MXU precision — exact selection, bf16-rounded values. The
+    coordinate carry and convex upsample stay fp32 (20 accumulated deltas are
+    the refinement's sensitive spot). Measured drift vs fp32:
+    tests/test_flow_bf16.py, docs/architecture.md.
     """
     if corr_impl not in ("volume", "volume_gather", "on_demand"):
         raise ValueError(
             f"corr_impl must be volume|volume_gather|on_demand, got {corr_impl!r}")
-    x1 = 2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0
-    x2 = 2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0
+    x1 = (2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
+    x2 = (2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
 
     f1 = _encoder(params["fnet"], x1, "instance").astype(jnp.float32)
     f2 = _encoder(params["fnet"], x2, "instance").astype(jnp.float32)
+    cnet = _encoder(params["cnet"], x1, "batch")
+    return _refine_flow(params, f1, f2, cnet, iters, taps, corr_impl, dtype)
+
+
+def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
+                        corr_impl: str = "volume", dtype=jnp.float32) -> jnp.ndarray:
+    """Flow for all consecutive frame pairs, sharing per-frame features.
+
+    ``frames``: (F, H, W, 3) → (F−1, H, W, 2), or a clip batch (N, F, H, W, 3)
+    → (N, F−1, H, W, 2) — pairs never cross clip boundaries.
+
+    TPU-first formulation of the reference's pair loop: ``fnet`` runs ONCE per
+    frame (clips flattened into the conv batch axis) and pairs are formed by
+    slicing the shared features, instead of encoding ``frames[:-1]`` and
+    ``frames[1:]`` separately (every interior frame twice); ``cnet`` runs on
+    the F−1 source frames as before. Numerics identical to
+    :func:`raft_forward` on split pair batches — per-sample conv arithmetic
+    does not depend on batch neighbors.
+    """
+    if corr_impl not in ("volume", "volume_gather", "on_demand"):
+        raise ValueError(
+            f"corr_impl must be volume|volume_gather|on_demand, got {corr_impl!r}")
+    lead = frames.shape[:-3]  # (F,) or (N, F)
+    n = int(np.prod(lead[:-1], dtype=np.int64)) if len(lead) > 1 else 1
+    nf = lead[-1]
+    h, w = frames.shape[-3:-1]
+    x = (2.0 * (frames.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
+    x = x.reshape((n * nf, h, w, 3))
+    feat = _encoder(params["fnet"], x, "instance").astype(jnp.float32)
+
+    def pairs(p, keep_first: bool):
+        _, ph, pw, c = p.shape
+        p = p.reshape(n, nf, ph, pw, c)
+        p = p[:, :-1] if keep_first else p[:, 1:]
+        return p.reshape(n * (nf - 1), ph, pw, c)
+
+    cnet = _encoder(params["cnet"], pairs(x, True), "batch")
+    flow = _refine_flow(params, pairs(feat, True), pairs(feat, False), cnet,
+                        iters, None, corr_impl, dtype)
+    return flow.reshape(lead[:-1] + (nf - 1, h, w, 2))
+
+
+def _refine_flow(params: Dict, f1: jnp.ndarray, f2: jnp.ndarray, cnet: jnp.ndarray,
+                 iters: int, taps, corr_impl: str, dtype=jnp.float32) -> jnp.ndarray:
+    """Shared post-encoder body: correlation pyramid + iterative GRU refinement.
+
+    ``dtype`` drives the motion-encoder/GRU/flow-head convs and the stored
+    correlation pyramid (fp32-accumulated, then cast); the coords/flow carry
+    stays fp32 regardless — sub-pixel refinement accumulates 20 deltas, and
+    bf16's 8 mantissa bits would quantize the carry itself, not just each
+    step's conv noise.
+    """
     if corr_impl in ("volume", "volume_gather"):
-        pyramid = _build_pyramid(f1, f2)
+        pyramid = _build_pyramid(f1, f2, dtype)
         impl = "matmul" if corr_impl == "volume" else "gather"
         lookup = lambda coords: _lookup(pyramid, coords, impl)  # noqa: E731
     else:
         f2_pyramid = _build_f2_pyramid(f2)
         lookup = lambda coords: _lookup_on_demand(f1, f2_pyramid, coords)  # noqa: E731
 
-    cnet = _encoder(params["cnet"], x1, "batch")
-    net = jnp.tanh(cnet[..., :HIDDEN_DIM])
-    inp = _relu(cnet[..., HIDDEN_DIM:])
+    net = jnp.tanh(cnet[..., :HIDDEN_DIM]).astype(dtype)
+    inp = _relu(cnet[..., HIDDEN_DIM:]).astype(dtype)
 
     b, h8, w8, _ = f1.shape
     coords0 = coords_grid(b, h8, w8)
@@ -332,13 +416,13 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
 
     def body(carry, _):
         net, coords1 = carry
-        corr = lookup(coords1)
-        flow = coords1 - coords0
+        corr = lookup(coords1).astype(dtype)
+        flow = (coords1 - coords0).astype(dtype)
         motion = _motion_encoder(up["encoder"], flow, corr)
         net = _sep_conv_gru(up["gru"], net, jnp.concatenate([inp, motion], -1))
         delta = conv2d(up["flow_head"]["conv2"],
                        _relu(conv2d(up["flow_head"]["conv1"], net, 1, 1)), 1, 1)
-        return (net, coords1 + delta), None
+        return (net, coords1 + delta.astype(jnp.float32)), None
 
     if taps is None:
         (net, coords1), _ = lax.scan(body, (net, coords0), None, length=iters)

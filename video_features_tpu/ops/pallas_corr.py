@@ -35,9 +35,15 @@ _VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def corr81_xla(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
-    """Channel-mean cost volume over the 9×9 displacement window (pure XLA)."""
+    """Channel-mean cost volume over the 9×9 displacement window (pure XLA).
+
+    Accumulates in fp32 whatever the feature dtype; the result is cast back to
+    the input dtype so a bf16 forward stays bf16 downstream (a fp32 volume
+    would silently promote every decoder conv through ``concatenate``).
+    """
     b, h, w, c = f1.shape
     r = CORR_RADIUS
+    dtype = f1.dtype
     f2p = jnp.pad(f2, ((0, 0), (r, r), (r, r), (0, 0)))
     f1 = f1.astype(jnp.float32)
     taps = []
@@ -45,7 +51,7 @@ def corr81_xla(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
         for dx in range(-r, r + 1):
             shifted = f2p[:, r + dy : r + dy + h, r + dx : r + dx + w, :].astype(jnp.float32)
             taps.append(jnp.mean(f1 * shifted, axis=-1))
-    return jnp.stack(taps, axis=-1)
+    return jnp.stack(taps, axis=-1).astype(dtype)
 
 
 def _corr81_kernel(f1_ref, f2p_ref, out_ref):
@@ -116,9 +122,10 @@ def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
     if impl == "pallas_interpret":
         return corr81_pallas(f1, f2, interpret=True)
     if impl == "pallas":
-        if jax.default_backend() != "tpu" or not _pallas_supported(b, h, w, c):
+        if (jax.default_backend() != "tpu" or f1.dtype != jnp.float32
+                or not _pallas_supported(b, h, w, c)):
             # Mosaic compiles TPU-only (tests use pallas_interpret); unsupported
-            # tiles and non-TPU backends take the fused XLA path
+            # tiles, non-fp32 dtypes, and non-TPU backends take the XLA path
             return corr81_xla(f1, f2)
         return corr81_pallas(f1, f2)
     raise ValueError(f"unknown corr impl {impl!r}; expected xla|pallas|pallas_interpret")
