@@ -1,0 +1,63 @@
+"""Shared-frame flow forwards vs the pair-split forwards (fast subset).
+
+These four small-shape tests are the direct check of the shared-frame encoding
+the production I3D sandwich and single-device ExtractFlow run on
+(raft_forward_frames / pwc_forward_frames): per-frame features sliced into
+pairs must reproduce the pair-split forward, and clip batches must never pair
+across clip boundaries. Kept OUT of the slow-marked parity files so the
+default `pytest` run still covers the production flow path.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from video_features_tpu.models.pwc import pwc_forward, pwc_forward_frames, pwc_init_params
+from video_features_tpu.models.raft import raft_forward, raft_forward_frames, raft_init_params
+
+def test_raft_forward_frames_matches_pair_forward():
+    """Shared-frame encoding (fnet once per frame) must reproduce the
+    pair-split forward; also covers the fused GRU gate convs."""
+    rng = np.random.default_rng(11)
+    params = raft_init_params(0)
+    frames = jnp.asarray(rng.uniform(0, 255, (4, 48, 56, 3)).astype(np.float32))
+    pair = raft_forward(params, frames[:-1], frames[1:], iters=4)
+    shared = raft_forward_frames(params, frames, iters=4)
+    assert shared.shape == (3, 48, 56, 2)
+    np.testing.assert_allclose(np.asarray(shared), np.asarray(pair),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_raft_forward_frames_clip_batch_no_cross_clip_pairs():
+    """(N, F, H, W, 3) clip batches pair only within a clip."""
+    rng = np.random.default_rng(12)
+    params = raft_init_params(0)
+    clips = jnp.asarray(rng.uniform(0, 255, (2, 3, 32, 40, 3)).astype(np.float32))
+    batched = np.asarray(raft_forward_frames(params, clips, iters=3))
+    assert batched.shape == (2, 2, 32, 40, 2)
+    for i in range(2):
+        single = np.asarray(raft_forward_frames(params, clips[i], iters=3))
+        np.testing.assert_allclose(batched[i], single, rtol=1e-4, atol=1e-4)
+
+
+def test_pwc_forward_frames_matches_pair_forward():
+    """Shared-pyramid encoding must reproduce the pair-split forward."""
+    rng = np.random.default_rng(13)
+    params = pwc_init_params(0)
+    frames = jnp.asarray(rng.uniform(0, 255, (4, 96, 128, 3)).astype(np.float32))
+    pair = pwc_forward(params, frames[:-1], frames[1:])
+    shared = pwc_forward_frames(params, frames)
+    assert shared.shape == (3, 96, 128, 2)
+    np.testing.assert_allclose(np.asarray(shared), np.asarray(pair),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pwc_forward_frames_clip_batch_no_cross_clip_pairs():
+    rng = np.random.default_rng(14)
+    params = pwc_init_params(0)
+    clips = jnp.asarray(rng.uniform(0, 255, (2, 3, 64, 64, 3)).astype(np.float32))
+    batched = np.asarray(pwc_forward_frames(params, clips))
+    assert batched.shape == (2, 2, 64, 64, 2)
+    for i in range(2):
+        single = np.asarray(pwc_forward_frames(params, clips[i]))
+        np.testing.assert_allclose(batched[i], single, rtol=1e-4, atol=1e-4)

@@ -74,30 +74,3 @@ def test_flow_parity(converted):
     np.testing.assert_allclose(out, ref, rtol=1e-3, atol=2e-3)
     cos = np.sum(out * ref) / (np.linalg.norm(out) * np.linalg.norm(ref))
     assert cos > 1 - 1e-5
-
-
-def test_forward_frames_matches_pair_forward():
-    """Shared-pyramid encoding must reproduce the pair-split forward."""
-    from video_features_tpu.models.pwc import pwc_forward, pwc_forward_frames
-
-    rng = np.random.default_rng(13)
-    params = pwc_init_params(0)
-    frames = jnp.asarray(rng.uniform(0, 255, (4, 96, 128, 3)).astype(np.float32))
-    pair = pwc_forward(params, frames[:-1], frames[1:])
-    shared = pwc_forward_frames(params, frames)
-    assert shared.shape == (3, 96, 128, 2)
-    np.testing.assert_allclose(np.asarray(shared), np.asarray(pair),
-                               rtol=1e-4, atol=1e-4)
-
-
-def test_forward_frames_clip_batch_no_cross_clip_pairs():
-    from video_features_tpu.models.pwc import pwc_forward_frames
-
-    rng = np.random.default_rng(14)
-    params = pwc_init_params(0)
-    clips = jnp.asarray(rng.uniform(0, 255, (2, 3, 64, 64, 3)).astype(np.float32))
-    batched = np.asarray(pwc_forward_frames(params, clips))
-    assert batched.shape == (2, 2, 64, 64, 2)
-    for i in range(2):
-        single = np.asarray(pwc_forward_frames(params, clips[i]))
-        np.testing.assert_allclose(batched[i], single, rtol=1e-4, atol=1e-4)

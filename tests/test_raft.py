@@ -136,32 +136,3 @@ def test_matmul_lookup_zero_padding_out_of_bounds(converted):
     mm = np.asarray(_lookup(pyr, coords, "matmul"))
     ga = np.asarray(_lookup(pyr, coords, "gather"))
     np.testing.assert_array_equal(mm, ga)
-
-
-def test_forward_frames_matches_pair_forward():
-    """Shared-frame encoding (fnet once per frame) must reproduce the
-    pair-split forward; also covers the fused GRU gate convs."""
-    from video_features_tpu.models.raft import raft_forward, raft_forward_frames
-
-    rng = np.random.default_rng(11)
-    params = raft_init_params(0)
-    frames = jnp.asarray(rng.uniform(0, 255, (4, 48, 56, 3)).astype(np.float32))
-    pair = raft_forward(params, frames[:-1], frames[1:], iters=4)
-    shared = raft_forward_frames(params, frames, iters=4)
-    assert shared.shape == (3, 48, 56, 2)
-    np.testing.assert_allclose(np.asarray(shared), np.asarray(pair),
-                               rtol=1e-4, atol=1e-4)
-
-
-def test_forward_frames_clip_batch_no_cross_clip_pairs():
-    """(N, F, H, W, 3) clip batches pair only within a clip."""
-    from video_features_tpu.models.raft import raft_forward_frames
-
-    rng = np.random.default_rng(12)
-    params = raft_init_params(0)
-    clips = jnp.asarray(rng.uniform(0, 255, (2, 3, 32, 40, 3)).astype(np.float32))
-    batched = np.asarray(raft_forward_frames(params, clips, iters=3))
-    assert batched.shape == (2, 2, 32, 40, 2)
-    for i in range(2):
-        single = np.asarray(raft_forward_frames(params, clips[i], iters=3))
-        np.testing.assert_allclose(batched[i], single, rtol=1e-4, atol=1e-4)

@@ -1,13 +1,15 @@
 """Stage-level PWC-Net timing: pyramid extractor vs cost volumes vs warps vs
 dense decoders.
 
-Measured (v5e, batch 16 × 256², fp32): full 60 ms; extractor2x 92 ms
-standalone (materializing all 12 level outputs — inside the full forward the
-unused level-1 maps fuse away, but the extractor remains the dominant stage),
-corr_all 29 ms, warp_all ≤12 ms (noise-limited). Conclusion: PWC is bound by
-the small-channel pyramid convs (16-32 channels at 128²/64² — low MXU
-contraction depth), NOT by the warp gathers — no RAFT-style lookup surgery to
-do here.
+Measured (v5e, batch 16 × 256², fp32, round 3): full 60.7 ms vs full_frames
+(shared per-frame pyramid) 57.9 ms — only ~5%, revising the round-2 reading:
+the standalone extractor2x number (20-36 ms, run-dependent) is dominated by
+MATERIALIZING all 12 level outputs to HBM, while inside the full forward the
+pyramid fuses into its consumers and costs little. The step is bound by the
+coarse-to-fine DenseNet decoders + cost volumes + warps, which are
+conv-dominated → the effective lever is ``--flow_dtype bfloat16``, not
+further encoder sharing. (Shared frames still matter for RAFT, whose fnet is
+a real 17 ms stage.)
 
 Same methodology as the other profilers (tools/_bench_util). Stages:
 
