@@ -114,3 +114,23 @@ def test_cli_vggish_postprocess_flag():
     assert cfg.vggish_postprocess is True
     assert parse_args(["--feature_type", "vggish", "--video_paths", "a.wav"]
                       ).vggish_postprocess is False
+
+
+def test_cli_flow_dtype_and_use_ffmpeg():
+    cfg = parse_args(["--feature_type", "pwc", "--video_paths", "a.mp4",
+                      "--flow_dtype", "bfloat16", "--use_ffmpeg", "never"])
+    assert cfg.flow_dtype == "bfloat16"
+    assert cfg.use_ffmpeg == "never"
+    d = parse_args(["--feature_type", "pwc", "--video_paths", "a.mp4"])
+    assert d.flow_dtype == "float32" and d.use_ffmpeg == "auto"
+
+
+def test_config_rejects_bad_flow_dtype_and_ffmpeg():
+    import pytest
+
+    from video_features_tpu.config import ExtractionConfig
+
+    with pytest.raises(ValueError):
+        ExtractionConfig(feature_type="pwc", flow_dtype="fp16").validate()
+    with pytest.raises(ValueError):
+        ExtractionConfig(feature_type="pwc", use_ffmpeg="maybe").validate()

@@ -1,0 +1,67 @@
+"""Fast direct tests of the TPU kernel lowerings (default test subset).
+
+Covers the production paths a slow-marked file would hide from the default
+run: the spatially tiled Pallas cost-volume kernel (interpret mode) and the
+bf16 TapConv3D lowering every bf16 I3D conv takes.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+def test_corr81_pallas_tiled_matches_xla():
+    """The spatially tiled kernel (interpret mode on CPU) must match the XLA
+    formulation at sizes beyond the 16² single-block cap, including non-/16
+    sizes exercising the pad-and-slice path."""
+    from video_features_tpu.ops.pallas_corr import corr81_pallas_tiled, corr81_xla
+
+    rng = np.random.default_rng(7)
+    for h, w, c in ((32, 32, 8), (24, 40, 4), (18, 23, 5)):
+        f1 = jnp.asarray(rng.standard_normal((2, h, w, c)).astype(np.float32))
+        f2 = jnp.asarray(rng.standard_normal((2, h, w, c)).astype(np.float32))
+        ref = np.asarray(corr81_xla(f1, f2))
+        out = np.asarray(corr81_pallas_tiled(f1, f2, interpret=True))
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_tap_conv3d_matches_direct_conv():
+    """The bf16 tap lowering must equal nn.Conv's conv3d (same TF-SAME pads);
+    checked in fp32 where equality is tight (bf16 only reassociates further)."""
+    import flax.linen as fnn
+
+    from video_features_tpu.models.layers import TapConv3D, tf_same_pads
+
+    rng = np.random.default_rng(3)
+    for kernel, stride in (((7, 7, 7), (2, 2, 2)), ((3, 3, 3), (1, 1, 1)),
+                           ((1, 1, 1), (1, 1, 1))):
+        x = jnp.asarray(rng.standard_normal((2, 8, 12, 12, 4)).astype(np.float32))
+        tap = TapConv3D(6, kernel, stride, dtype=jnp.float32)
+        params = tap.init(jax.random.PRNGKey(0), x)
+        out = tap.apply(params, x)
+        kern = params["params"]["kernel"]
+        ref = fnn.Conv(6, kernel, strides=stride,
+                       padding=tf_same_pads(kernel, stride), use_bias=False,
+                       dtype=jnp.float32).apply({"params": {"kernel": kern}}, x)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_i3d_bf16_tap_path_close_to_fp32():
+    """dtype=bfloat16 now routes convs through TapConv3D; features must stay
+    near the fp32 model (same params)."""
+    from video_features_tpu.models.i3d import I3D
+    from video_features_tpu.weights.store import random_params_like
+
+    m32 = I3D(modality="rgb", dtype=jnp.float32)
+    mbf = I3D(modality="rgb", dtype=jnp.bfloat16)
+    x = jnp.asarray(np.random.default_rng(4).uniform(-1, 1, (1, 16, 64, 64, 3))
+                    .astype(np.float32))
+    p = random_params_like(lambda r, d: m32.init(r, d, features=True),
+                           jax.random.PRNGKey(0), x)["params"]
+    f32 = np.asarray(m32.apply({"params": p}, x, features=True))
+    fbf = np.asarray(mbf.apply({"params": p}, x, features=True))
+    scale = np.abs(f32).max() + 1e-6
+    assert np.abs(f32 - fbf).max() <= 0.05 * scale

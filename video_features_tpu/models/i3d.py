@@ -27,6 +27,7 @@ import jax.numpy as jnp
 
 from .layers import (
     S2DStemConv,
+    TapConv3D,
     TorchBatchNorm,
     avg_pool_valid,
     max_pool_tf_same,
@@ -77,6 +78,12 @@ class Unit3D(nn.Module):
             assert tuple(self.kernel) == (7, 7, 7) and tuple(self.stride) == (2, 2, 2)
             assert not self.use_bias
             x = S2DStemConv(self.features, dtype=self.dtype, name="conv3d")(x)
+        elif self.dtype == jnp.bfloat16 and not self.use_bias:
+            # bf16 conv3d is pathological on this backend (see TapConv3D);
+            # lower every bf16 conv as per-temporal-tap conv2ds — same TF-SAME
+            # semantics, same param tree, ~1e-6 temporal reassociation
+            x = TapConv3D(self.features, tuple(self.kernel), tuple(self.stride),
+                          dtype=self.dtype, name="conv3d")(x)
         else:
             x = nn.Conv(
                 self.features,

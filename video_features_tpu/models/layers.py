@@ -112,6 +112,57 @@ class S2DStemConv(nn.Module):
         return y[:, : out_sizes[0], : out_sizes[1], : out_sizes[2], :]
 
 
+class TapConv3D(nn.Module):
+    """conv3d lowered as a sum of per-temporal-tap conv2ds (TF-SAME pads).
+
+    Why: on the v5e backend, XLA's conv3d lowering is PATHOLOGICAL in bf16 —
+    measured on the I3D stem (4 clips × 64 × 224², 7³/2³): conv3d fp32
+    13.5 ms, conv3d bf16 **21.7 ms** (slower than fp32!), while the same math
+    as 7 temporal taps of stride-2 conv2d runs **5.5 ms** in bf16 (2.4× the
+    fp32 conv3d). This is the root cause of round 2's "bf16 buys I3D nothing":
+    the stem is two-thirds of the step and its bf16 conv3d regression swallowed
+    every other layer's gain. fp32 keeps the direct conv3d (taps reassociate
+    the temporal accumulation — ~1e-6 drift — and fp32 is the bit-parity path).
+
+    Semantics: identical to ``nn.Conv(kernel, stride, tf_same_pads)`` — the
+    input is zero-padded with the reference's TF-SAME amounts on every axis,
+    each temporal kernel tap becomes a strided conv2d over the (N·T_out) frame
+    batch, and the taps are summed. Param tree matches ``nn.Conv`` (``kernel``
+    HWIO) so converted checkpoints load unchanged.
+    """
+
+    features: int
+    kernel: Sequence[int]
+    stride: Sequence[int]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        c = x.shape[-1]
+        kt, kh, kw = self.kernel
+        st, sh, sw = self.stride
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (kt, kh, kw, c, self.features), jnp.float32,
+        ).astype(self.dtype)
+        x = x.astype(self.dtype)
+        (pt0, pt1), sp_h, sp_w = tf_same_pads(self.kernel, self.stride)
+        if pt0 or pt1:
+            x = jnp.pad(x, ((0, 0), (pt0, pt1), (0, 0), (0, 0), (0, 0)))
+        n, tp, h, w, _ = x.shape
+        t_out = (tp - kt) // st + 1
+        acc = None
+        for dt in range(kt):
+            xt = x[:, dt : dt + (t_out - 1) * st + 1 : st]
+            xt = xt.reshape((n * t_out, h, w, c))
+            y = lax.conv_general_dilated(
+                xt, kernel[dt], window_strides=(sh, sw), padding=(sp_h, sp_w),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            acc = y if acc is None else acc + y
+        return acc.reshape((n, t_out) + acc.shape[1:])
+
+
 def max_pool_tf_same(
     x: jnp.ndarray, kernel: Sequence[int], stride: Sequence[int]
 ) -> jnp.ndarray:

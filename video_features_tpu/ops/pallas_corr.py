@@ -10,11 +10,14 @@ Two TPU implementations, selectable per call (``--pwc_corr``):
 
 - ``xla``: 81 shifted elementwise products + channel mean. XLA fuses the shifts
   into a few HBM passes; this is the parity-proven default.
-- ``pallas``: one VMEM-resident tile per batch element — fmap1, the padded
-  fmap2, and all 81 output channels stay on-chip; the 9×9 window walk reads the
-  padded tile 81× from VMEM instead of HBM. Statically dispatched per shape:
-  tiles outside the supported range (see ``_pallas_supported``) fall back to
-  ``xla``, so one PWC forward mixes kernel levels and XLA levels.
+- ``pallas``: VMEM-resident kernels. Spatial sizes ≤16² run the single-block
+  kernel (whole image per grid step); larger sizes run the spatially TILED
+  kernel (``corr81_pallas_tiled``: 16×16 output blocks, the haloed f2 held
+  VMEM-resident per image) — the axon Mosaic backend rejects >16² compute
+  tiles, so tiling is how the 32²/64² PWC levels get in-kernel. Shapes whose
+  resident f2 exceeds the VMEM budget (``_pallas_tiled_supported``) and
+  non-fp32 dtypes fall back to ``xla``; dispatch is static per call site, so
+  one PWC forward may mix kernel and XLA levels.
 
 Both are exercised by tests/test_pallas_corr.py (Pallas in interpreter mode on
 CPU, compiled on TPU).
@@ -94,6 +97,79 @@ def corr81_pallas(f1: jnp.ndarray, f2: jnp.ndarray, interpret: bool = False) -> 
     )(f1, f2p)
 
 
+_TILE = 16  # largest tile the axon Mosaic backend compiles (>16² → HTTP 500)
+
+
+def _corr81_kernel_tiled(f1_ref, f2p_ref, out_ref):
+    """Spatially tiled kernel: one 16×16 output block per grid step.
+
+    Grid (b, nh, nw). ``f1`` arrives as a (1, 16, 16, C) block; the padded
+    ``f2`` arrives as the FULL (1, Hp+8, Wp+8, C) image — its block index is
+    constant across (j, k), so Mosaic keeps it VMEM-resident instead of
+    re-fetching per step. The 24×24 haloed window for this block is a dynamic
+    slice; the 81 taps are static shifts within it.
+    """
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    halo = 2 * CORR_RADIUS
+    tile = f2p_ref[0, pl.dslice(j * _TILE, _TILE + halo),
+                   pl.dslice(k * _TILE, _TILE + halo), :]
+    f1 = f1_ref[0].astype(jnp.float32)
+    c = f1.shape[-1]
+    taps = []
+    for dy in range(2 * CORR_RADIUS + 1):
+        for dx in range(2 * CORR_RADIUS + 1):
+            shifted = tile[dy : dy + _TILE, dx : dx + _TILE, :].astype(jnp.float32)
+            taps.append(jnp.sum(f1 * shifted, axis=-1) * (1.0 / c))
+    out_ref[0] = jnp.stack(taps, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def corr81_pallas_tiled(f1: jnp.ndarray, f2: jnp.ndarray,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Tiled Pallas cost volume for spatial sizes beyond the 16² Mosaic cap.
+
+    Pads H/W to multiples of the tile (zero rows/cols — out-of-bounds f2 taps
+    contribute zeros, exactly the reference's zero-padding; the padded f1 rows
+    produce extra output rows sliced off afterwards).
+    """
+    from jax.experimental import pallas as pl
+
+    b, h, w, c = f1.shape
+    r = CORR_RADIUS
+    ph = (-h) % _TILE
+    pw = (-w) % _TILE
+    f1p = jnp.pad(f1, ((0, 0), (0, ph), (0, pw), (0, 0)))
+    f2p = jnp.pad(f2, ((0, 0), (r, r + ph), (r, r + pw), (0, 0)))
+    hp, wp = h + ph, w + pw
+    out = pl.pallas_call(
+        _corr81_kernel_tiled,
+        out_shape=jax.ShapeDtypeStruct((b, hp, wp, CORR_CHANNELS), jnp.float32),
+        grid=(b, hp // _TILE, wp // _TILE),
+        in_specs=[
+            pl.BlockSpec((1, _TILE, _TILE, c), lambda i, j, k: (i, j, k, 0)),
+            pl.BlockSpec((1, hp + 2 * r, wp + 2 * r, c), lambda i, j, k: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _TILE, _TILE, CORR_CHANNELS),
+                               lambda i, j, k: (i, j, k, 0)),
+        interpret=interpret,
+    )(f1p, f2p)
+    return out[:, :h, :w, :]
+
+
+def _pallas_tiled_supported(b: int, h: int, w: int, c: int) -> bool:
+    """VMEM gate for the tiled kernel: the resident full f2p + one f1/out block
+    pair, double-buffered, must fit the budget."""
+    r = CORR_RADIUS
+    hp = h + (-h) % _TILE
+    wp = w + (-w) % _TILE
+    f2p_bytes = (hp + 2 * r) * (wp + 2 * r) * c * 4
+    blk_bytes = _TILE * _TILE * (c + CORR_CHANNELS) * 4
+    return 2 * (f2p_bytes + blk_bytes) <= _VMEM_BUDGET
+
+
 def _pallas_supported(b: int, h: int, w: int, c: int) -> bool:
     """Shape gate for the compiled kernel on the axon v5e backend (observed):
 
@@ -120,12 +196,17 @@ def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
         return corr81_xla(f1, f2)
     b, h, w, c = f1.shape
     if impl == "pallas_interpret":
+        if h > _TILE or w > _TILE:
+            return corr81_pallas_tiled(f1, f2, interpret=True)
         return corr81_pallas(f1, f2, interpret=True)
     if impl == "pallas":
-        if (jax.default_backend() != "tpu" or f1.dtype != jnp.float32
-                or not _pallas_supported(b, h, w, c)):
-            # Mosaic compiles TPU-only (tests use pallas_interpret); unsupported
-            # tiles, non-fp32 dtypes, and non-TPU backends take the XLA path
+        if jax.default_backend() != "tpu" or f1.dtype != jnp.float32:
+            # Mosaic compiles TPU-only (tests use pallas_interpret); non-fp32
+            # dtypes and non-TPU backends take the XLA path
             return corr81_xla(f1, f2)
-        return corr81_pallas(f1, f2)
+        if h <= _TILE and w <= _TILE and _pallas_supported(b, h, w, c):
+            return corr81_pallas(f1, f2)
+        if _pallas_tiled_supported(b, h, w, c):
+            return corr81_pallas_tiled(f1, f2)
+        return corr81_xla(f1, f2)
     raise ValueError(f"unknown corr impl {impl!r}; expected xla|pallas|pallas_interpret")
