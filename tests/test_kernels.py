@@ -65,3 +65,31 @@ def test_i3d_bf16_tap_path_close_to_fp32():
     fbf = np.asarray(mbf.apply({"params": p}, x, features=True))
     scale = np.abs(f32).max() + 1e-6
     assert np.abs(f32 - fbf).max() <= 0.05 * scale
+
+
+def test_resolve_corr_impl_auto_switches_on_volume_size(monkeypatch):
+    from video_features_tpu.models.raft import resolve_corr_impl
+
+    # 16 pairs at 256²: pyramid 16·(32·32)²·4 B·1.328 ≈ 89 MB → volume
+    assert resolve_corr_impl("auto", 16, 256, 256) == "volume"
+    # 16 pairs at 1080p: 16·(135·240)²·4 B·1.328 ≈ 89 GB — several times HBM
+    assert resolve_corr_impl("auto", 16, 1080, 1920) == "on_demand"
+    # explicit choices pass through untouched
+    for impl in ("volume", "volume_gather", "on_demand"):
+        assert resolve_corr_impl(impl, 16, 1080, 1920) == impl
+    # bf16 halves the volume: a geometry just past the fp32 budget fits
+    monkeypatch.setenv("VFT_RAFT_VOLUME_BUDGET", str(16 * (32 * 32) ** 2 * 4))
+    assert resolve_corr_impl("auto", 16, 256, 256) == "on_demand"  # 1.33x > 1x
+    assert resolve_corr_impl("auto", 16, 256, 256, jnp.bfloat16) == "volume"
+
+
+def test_raft_forward_accepts_auto():
+    from video_features_tpu.models.raft import raft_forward, raft_init_params
+
+    rng = np.random.default_rng(9)
+    params = raft_init_params(0)
+    x1 = jnp.asarray(rng.uniform(0, 255, (1, 32, 40, 3)).astype(np.float32))
+    x2 = jnp.asarray(rng.uniform(0, 255, (1, 32, 40, 3)).astype(np.float32))
+    auto = raft_forward(params, x1, x2, iters=2, corr_impl="auto")
+    vol = raft_forward(params, x1, x2, iters=2, corr_impl="volume")
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(vol))

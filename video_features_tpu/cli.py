@@ -65,11 +65,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "correlation ACCUMULATION and coordinate math stay "
                              "fp32 either way (float32 = reference parity; "
                              "measured bf16 drift in tests/test_flow_bf16.py)")
-    parser.add_argument("--raft_corr", choices=["volume", "volume_gather", "on_demand"],
-                        default="volume",
-                        help="RAFT correlation: materialized pyramid with MXU matmul "
-                             "lookup (default), the same pyramid with gather lookup, "
-                             "or on-demand (alt_cuda_corr equivalent, O(H*W) memory)")
+    parser.add_argument("--raft_corr",
+                        choices=["auto", "volume", "volume_gather", "on_demand"],
+                        default="auto",
+                        help="RAFT correlation: auto (default) = materialized "
+                             "pyramid with MXU matmul lookup unless the volume "
+                             "would outgrow HBM for the frame size, then the "
+                             "on-demand alt_cuda_corr equivalent (O(H*W) memory); "
+                             "or force volume / volume_gather / on_demand")
     parser.add_argument("--pwc_corr", choices=["xla", "pallas"], default="xla",
                         help="PWC cost-volume implementation")
     parser.add_argument("--decode_workers", type=int, default=1,

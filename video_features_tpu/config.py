@@ -68,9 +68,12 @@ class ExtractionConfig:
     # stay fp32 either way. float32 (default) is the reference-parity path.
     # Measured bf16 drift: tests/test_flow_bf16.py and docs/architecture.md.
     flow_dtype: str = "float32"
-    # RAFT correlation: "volume" materializes the all-pairs pyramid (reference
-    # default); "on_demand" is the alt_cuda_corr equivalent — O(H·W·D) memory.
-    raft_corr: str = "volume"
+    # RAFT correlation: "auto" (default) materializes the all-pairs pyramid
+    # (reference default path, same numerics) unless the volume would outgrow
+    # HBM for the frame geometry, then switches to "on_demand" (the
+    # alt_cuda_corr equivalent — O(H·W·D) memory instead of O((H·W)²));
+    # explicit "volume"/"volume_gather"/"on_demand" force a path.
+    raft_corr: str = "auto"
     # PWC cost volume: "xla" fused formulation (default) or the "pallas" tile
     # kernel (ops/pallas_corr).
     pwc_corr: str = "xla"
@@ -126,8 +129,8 @@ class ExtractionConfig:
             raise ValueError("clips_per_batch must be >= 1")
         if self.flow_dtype not in ("float32", "bfloat16"):
             raise ValueError("flow_dtype must be float32|bfloat16")
-        if self.raft_corr not in ("volume", "volume_gather", "on_demand"):
-            raise ValueError("raft_corr must be volume|volume_gather|on_demand")
+        if self.raft_corr not in ("auto", "volume", "volume_gather", "on_demand"):
+            raise ValueError("raft_corr must be auto|volume|volume_gather|on_demand")
         if self.pwc_corr not in ("xla", "pallas"):
             raise ValueError("pwc_corr must be 'xla' or 'pallas'")
         if self.matmul_precision not in (None, "default", "high", "highest"):

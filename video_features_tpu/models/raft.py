@@ -41,6 +41,35 @@ CORR_LEVELS = 4
 CORR_RADIUS = 4
 ITERS = 20  # reference inference default (raft.py:115)
 
+# HBM budget for the materialized all-pairs pyramid; past it, corr_impl
+# "auto" switches to the on-demand path (the alt_cuda_corr equivalent).
+# ~4 GiB leaves room for the one-hot selectors, activations, and double
+# buffering on a 16 GiB chip; override via VFT_RAFT_VOLUME_BUDGET (bytes).
+_VOLUME_HBM_BUDGET = 4 * 1024**3
+
+
+def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
+                      dtype=jnp.float32) -> str:
+    """Resolve ``auto`` per frame geometry: the reference-default materialized
+    volume while it fits, the O(H·W·D) on-demand path beyond. In fp32 the two
+    paths are numerically identical (tested); under ``dtype=bfloat16`` the
+    volume path stores a bf16 pyramid while on-demand keeps fp32 correlation
+    values, so the switchover changes rounding within the bf16 drift budget.
+
+    The pyramid holds ``n_pairs · (h/8·w/8)² · Σ4⁻ˡ`` correlation values
+    (corr.py:12-27 geometry); e.g. 16 pairs at 1080p → ~89 GB fp32, several
+    times HBM — exactly the case the reference's alt_cuda_corr serves.
+    """
+    if corr_impl != "auto":
+        return corr_impl
+    import os
+
+    budget = float(os.environ.get("VFT_RAFT_VOLUME_BUDGET", _VOLUME_HBM_BUDGET))
+    q = (h // 8) * (w // 8)
+    itemsize = 2 if dtype == jnp.bfloat16 else 4
+    vol_bytes = n_pairs * q * q * itemsize * (1 + 1 / 4 + 1 / 16 + 1 / 64)
+    return "volume" if vol_bytes <= budget else "on_demand"
+
 # (name, cin, cout, kernel, stride, pad) for plain convs; residual layers described
 # structurally in _encoder below.
 ENCODER_DIMS = (64, 64, 96, 128)  # stem, layer1, layer2, layer3
@@ -335,9 +364,11 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     the refinement's sensitive spot). Measured drift vs fp32:
     tests/test_flow_bf16.py, docs/architecture.md.
     """
+    corr_impl = resolve_corr_impl(corr_impl, image1.shape[0],
+                                  image1.shape[1], image1.shape[2], dtype)
     if corr_impl not in ("volume", "volume_gather", "on_demand"):
         raise ValueError(
-            f"corr_impl must be volume|volume_gather|on_demand, got {corr_impl!r}")
+            f"corr_impl must be auto|volume|volume_gather|on_demand, got {corr_impl!r}")
     x1 = (2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
     x2 = (2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
 
@@ -362,13 +393,14 @@ def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
     :func:`raft_forward` on split pair batches — per-sample conv arithmetic
     does not depend on batch neighbors.
     """
-    if corr_impl not in ("volume", "volume_gather", "on_demand"):
-        raise ValueError(
-            f"corr_impl must be volume|volume_gather|on_demand, got {corr_impl!r}")
     lead = frames.shape[:-3]  # (F,) or (N, F)
     n = int(np.prod(lead[:-1], dtype=np.int64)) if len(lead) > 1 else 1
     nf = lead[-1]
     h, w = frames.shape[-3:-1]
+    corr_impl = resolve_corr_impl(corr_impl, n * (nf - 1), h, w, dtype)
+    if corr_impl not in ("volume", "volume_gather", "on_demand"):
+        raise ValueError(
+            f"corr_impl must be auto|volume|volume_gather|on_demand, got {corr_impl!r}")
     x = (2.0 * (frames.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
     x = x.reshape((n * nf, h, w, 3))
     feat = _encoder(params["fnet"], x, "instance").astype(jnp.float32)

@@ -160,8 +160,15 @@ def corr81_pallas_tiled(f1: jnp.ndarray, f2: jnp.ndarray,
 
 
 def _pallas_tiled_supported(b: int, h: int, w: int, c: int) -> bool:
-    """VMEM gate for the tiled kernel: the resident full f2p + one f1/out block
-    pair, double-buffered, must fit the budget."""
+    """VMEM gate for the tiled kernel: the resident PER-IMAGE f2p + one
+    f1/out block pair, double-buffered, must fit the budget.
+
+    Unlike the single-block kernel (whose empirical budget scales with B —
+    see ``_pallas_supported``), the tiled call's buffers are streamed per
+    block: validated compiled on the axon v5e backend at b=16 × 64² × c32
+    (the largest PWC corr level at a 256² input), where a whole-buffer VMEM
+    assignment could not possibly fit — so only the per-step working set
+    counts here."""
     r = CORR_RADIUS
     hp = h + (-h) % _TILE
     wp = w + (-w) % _TILE
@@ -204,8 +211,13 @@ def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
             # Mosaic compiles TPU-only (tests use pallas_interpret); non-fp32
             # dtypes and non-TPU backends take the XLA path
             return corr81_xla(f1, f2)
-        if h <= _TILE and w <= _TILE and _pallas_supported(b, h, w, c):
-            return corr81_pallas(f1, f2)
+        if h <= _TILE and w <= _TILE:
+            # small spatial sizes keep the single-block kernel and its
+            # empirically calibrated B-scaled budget; shapes it rejects go to
+            # XLA (the tiled kernel targets the >16² spatial regime only)
+            if _pallas_supported(b, h, w, c):
+                return corr81_pallas(f1, f2)
+            return corr81_xla(f1, f2)
         if _pallas_tiled_supported(b, h, w, c):
             return corr81_pallas_tiled(f1, f2)
         return corr81_xla(f1, f2)
