@@ -49,6 +49,33 @@ def test_tap_conv3d_matches_direct_conv():
                                    rtol=1e-5, atol=1e-5)
 
 
+def test_tap_conv3d_explicit_pads_match_direct_conv():
+    """The explicit-padding branch (torch-style R21D pads, incl. asymmetric)
+    at the tight kernel-level tolerance — the end-to-end 5% feature test could
+    absorb a boundary-only lo/hi swap."""
+    import flax.linen as fnn
+
+    from video_features_tpu.models.layers import TapConv3D
+
+    rng = np.random.default_rng(5)
+    cases = (
+        ((1, 7, 7), (1, 2, 2), ((0, 0), (3, 3), (3, 3))),  # r21d stem
+        ((3, 1, 1), (2, 1, 1), ((1, 1), (0, 0), (0, 0))),  # strided temporal
+        ((3, 3, 3), (1, 1, 1), ((0, 1), (1, 2), (2, 0))),  # asymmetric pads
+    )
+    for kernel, stride, pads in cases:
+        x = jnp.asarray(rng.standard_normal((2, 7, 13, 13, 4)).astype(np.float32))
+        tap = TapConv3D(6, kernel, stride, dtype=jnp.float32, padding=pads)
+        params = tap.init(jax.random.PRNGKey(1), x)
+        out = tap.apply(params, x)
+        kern = params["params"]["kernel"]
+        ref = fnn.Conv(6, kernel, strides=stride, padding=pads, use_bias=False,
+                       dtype=jnp.float32).apply({"params": {"kernel": kern}}, x)
+        assert out.shape == ref.shape, (kernel, stride, pads)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+
 def test_i3d_bf16_tap_path_close_to_fp32():
     """dtype=bfloat16 now routes convs through TapConv3D; features must stay
     near the fp32 model (same params)."""
@@ -93,3 +120,21 @@ def test_raft_forward_accepts_auto():
     auto = raft_forward(params, x1, x2, iters=2, corr_impl="auto")
     vol = raft_forward(params, x1, x2, iters=2, corr_impl="volume")
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(vol))
+
+
+def test_r21d_bf16_tap_path_close_to_fp32():
+    """R(2+1)D's bf16 convs route through TapConv3D (same conv3d-bf16 backend
+    pathology as I3D); features must stay near the fp32 model on shared params."""
+    from video_features_tpu.models.r21d import R2Plus1D18
+    from video_features_tpu.weights.store import random_params_like
+
+    m32 = R2Plus1D18(dtype=jnp.float32)
+    mbf = R2Plus1D18(dtype=jnp.bfloat16)
+    x = jnp.asarray(np.random.default_rng(8).uniform(-2, 2, (1, 4, 56, 56, 3))
+                    .astype(np.float32))
+    p = random_params_like(lambda r, d: m32.init(r, d, features=True),
+                           jax.random.PRNGKey(0), x)["params"]
+    f32 = np.asarray(m32.apply({"params": p}, x, features=True))
+    fbf = np.asarray(mbf.apply({"params": p}, x, features=True))
+    scale = np.abs(f32).max() + 1e-6
+    assert np.abs(f32 - fbf).max() <= 0.05 * scale

@@ -27,9 +27,9 @@ import jax.numpy as jnp
 
 from .layers import (
     S2DStemConv,
-    TapConv3D,
     TorchBatchNorm,
     avg_pool_valid,
+    conv3d_module,
     max_pool_tf_same,
     tf_same_pads,
 )
@@ -78,19 +78,19 @@ class Unit3D(nn.Module):
             assert tuple(self.kernel) == (7, 7, 7) and tuple(self.stride) == (2, 2, 2)
             assert not self.use_bias
             x = S2DStemConv(self.features, dtype=self.dtype, name="conv3d")(x)
-        elif self.dtype == jnp.bfloat16 and not self.use_bias:
-            # bf16 conv3d is pathological on this backend (see TapConv3D);
-            # lower every bf16 conv as per-temporal-tap conv2ds — same TF-SAME
-            # semantics, same param tree, ~1e-6 temporal reassociation
-            x = TapConv3D(self.features, tuple(self.kernel), tuple(self.stride),
-                          dtype=self.dtype, name="conv3d")(x)
+        elif not self.use_bias:
+            # shared chooser: bf16 takes the TapConv3D lowering (conv3d-bf16
+            # backend pathology), fp32 the direct conv — same param tree
+            x = conv3d_module(self.features, self.kernel, self.stride,
+                              tf_same_pads(self.kernel, self.stride),
+                              self.dtype, "conv3d")(x)
         else:
             x = nn.Conv(
                 self.features,
                 tuple(self.kernel),
                 strides=tuple(self.stride),
                 padding=tf_same_pads(self.kernel, self.stride),
-                use_bias=self.use_bias,
+                use_bias=True,
                 dtype=self.dtype,
                 name="conv3d",
             )(x)

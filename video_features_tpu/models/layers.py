@@ -135,6 +135,9 @@ class TapConv3D(nn.Module):
     kernel: Sequence[int]
     stride: Sequence[int]
     dtype: Any = jnp.float32
+    # explicit per-axis (lo, hi) pads (torch-style models, e.g. R(2+1)D);
+    # None = the I3D TF-SAME rule
+    padding: Any = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -146,7 +149,9 @@ class TapConv3D(nn.Module):
             (kt, kh, kw, c, self.features), jnp.float32,
         ).astype(self.dtype)
         x = x.astype(self.dtype)
-        (pt0, pt1), sp_h, sp_w = tf_same_pads(self.kernel, self.stride)
+        pads = (tuple(self.padding) if self.padding is not None
+                else tf_same_pads(self.kernel, self.stride))
+        (pt0, pt1), sp_h, sp_w = pads
         if pt0 or pt1:
             x = jnp.pad(x, ((0, 0), (pt0, pt1), (0, 0), (0, 0), (0, 0)))
         n, tp, h, w, _ = x.shape
@@ -161,6 +166,23 @@ class TapConv3D(nn.Module):
             )
             acc = y if acc is None else acc + y
         return acc.reshape((n, t_out) + acc.shape[1:])
+
+
+def conv3d_module(features: int, kernel: Sequence[int], stride: Sequence[int],
+                  padding: Sequence[Tuple[int, int]], dtype: Any, name: str):
+    """The one conv3d chooser (bias-free convs): bf16 routes through
+    :class:`TapConv3D` (XLA's conv3d lowering is pathological in bf16 on this
+    backend — see TapConv3D's measurements), fp32 keeps ``nn.Conv`` for bit
+    parity. ``padding`` is REQUIRED explicit per-axis (lo, hi) pads — Flax's
+    string "SAME" pads asymmetrically ((2,3) for 7/2) where torch models pad
+    symmetrically, a silent numerics trap no call site should be able to hit.
+    """
+    padding = tuple(tuple(p) for p in padding)
+    if dtype == jnp.bfloat16:
+        return TapConv3D(features, tuple(kernel), tuple(stride), dtype=dtype,
+                         padding=padding, name=name)
+    return nn.Conv(features, tuple(kernel), strides=tuple(stride),
+                   padding=padding, use_bias=False, dtype=dtype, name=name)
 
 
 def max_pool_tf_same(

@@ -23,7 +23,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
-from .layers import TorchBatchNorm
+from .layers import TorchBatchNorm, conv3d_module as _conv3d
 
 STAGE_CHANNELS = (64, 128, 256, 512)
 NUM_FEATURES = 512
@@ -44,14 +44,12 @@ class Conv2Plus1D(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         s = self.stride
-        x = nn.Conv(self.mid, (1, 3, 3), strides=(1, s, s),
-                    padding=((0, 0), (1, 1), (1, 1)), use_bias=False,
-                    dtype=self.dtype, name="0")(x)
+        x = _conv3d(self.mid, (1, 3, 3), (1, s, s),
+                    ((0, 0), (1, 1), (1, 1)), self.dtype, "0")(x)
         x = TorchBatchNorm(dtype=self.dtype, name="1")(x)
         x = nn.relu(x)
-        return nn.Conv(self.cout, (3, 1, 1), strides=(s, 1, 1),
-                       padding=((1, 1), (0, 0), (0, 0)), use_bias=False,
-                       dtype=self.dtype, name="3")(x)
+        return _conv3d(self.cout, (3, 1, 1), (s, 1, 1),
+                       ((1, 1), (0, 0), (0, 0)), self.dtype, "3")(x)
 
 
 class BasicBlock(nn.Module):
@@ -73,8 +71,8 @@ class BasicBlock(nn.Module):
         y = Conv2Plus1D(self.cout, mid, 1, self.dtype, name="conv2.0")(y)
         y = TorchBatchNorm(dtype=self.dtype, name="conv2.1")(y)
         if self.stride != 1 or self.cin != self.cout:
-            x = nn.Conv(self.cout, (1, 1, 1), strides=(self.stride,) * 3,
-                        use_bias=False, dtype=self.dtype, name="downsample.0")(x)
+            x = _conv3d(self.cout, (1, 1, 1), (self.stride,) * 3,
+                        ((0, 0), (0, 0), (0, 0)), self.dtype, "downsample.0")(x)
             x = TorchBatchNorm(dtype=self.dtype, name="downsample.1")(x)
         return nn.relu(x + y)
 
@@ -88,13 +86,12 @@ class R2Plus1D18(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, features: bool = True) -> jnp.ndarray:
         x = x.astype(self.dtype)
-        x = nn.Conv(45, (1, 7, 7), strides=(1, 2, 2),
-                    padding=((0, 0), (3, 3), (3, 3)), use_bias=False,
-                    dtype=self.dtype, name="stem.0")(x)
+        x = _conv3d(45, (1, 7, 7), (1, 2, 2),
+                    ((0, 0), (3, 3), (3, 3)), self.dtype, "stem.0")(x)
         x = TorchBatchNorm(dtype=self.dtype, name="stem.1")(x)
         x = nn.relu(x)
-        x = nn.Conv(64, (3, 1, 1), padding=((1, 1), (0, 0), (0, 0)), use_bias=False,
-                    dtype=self.dtype, name="stem.3")(x)
+        x = _conv3d(64, (3, 1, 1), (1, 1, 1),
+                    ((1, 1), (0, 0), (0, 0)), self.dtype, "stem.3")(x)
         x = TorchBatchNorm(dtype=self.dtype, name="stem.4")(x)
         x = nn.relu(x)
 
