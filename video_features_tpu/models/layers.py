@@ -113,7 +113,8 @@ class S2DStemConv(nn.Module):
 
 
 class TapConv3D(nn.Module):
-    """conv3d lowered as a sum of per-temporal-tap conv2ds (TF-SAME pads).
+    """conv3d lowered as a sum of per-temporal-tap conv2ds (TF-SAME pads by
+    default; torch-style explicit per-axis pads via ``padding``).
 
     Why: on the v5e backend, XLA's conv3d lowering is PATHOLOGICAL in bf16 —
     measured on the I3D stem (4 clips × 64 × 224², 7³/2³): conv3d fp32
@@ -124,9 +125,10 @@ class TapConv3D(nn.Module):
     every other layer's gain. fp32 keeps the direct conv3d (taps reassociate
     the temporal accumulation — ~1e-6 drift — and fp32 is the bit-parity path).
 
-    Semantics: identical to ``nn.Conv(kernel, stride, tf_same_pads)`` — the
-    input is zero-padded with the reference's TF-SAME amounts on every axis,
-    each temporal kernel tap becomes a strided conv2d over the (N·T_out) frame
+    Semantics: identical to ``nn.Conv(kernel, stride, pads)`` with ``pads`` =
+    the reference's TF-SAME amounts (default) or the explicit per-axis (lo, hi)
+    pads given via ``padding`` — the input is zero-padded on every axis, each
+    temporal kernel tap becomes a strided conv2d over the (N·T_out) frame
     batch, and the taps are summed. Param tree matches ``nn.Conv`` (``kernel``
     HWIO) so converted checkpoints load unchanged.
     """
