@@ -196,6 +196,18 @@ def main() -> None:
             **kw,
         )
 
+    # details are flushed after EVERY entry: a late-section failure (e.g. an
+    # OOM compiling one e2e config) must not lose the whole run's record
+    details_name = "bench_details_cpu_smoke.json" if on_cpu else "bench_details.json"
+
+    def flush_details():
+        # atomic swap: a kill mid-write must not truncate the record the
+        # incremental flushing exists to protect
+        path = os.path.join(REPO, details_name)
+        with open(path + ".tmp", "w") as f:
+            json.dump(details, f, indent=2)
+        os.replace(path + ".tmp", path)
+
     def record(name, timing, units_per_iter, unit, flops_per_iter, chips=None):
         secs_per_iter, sync, iters_run = timing
         tflops = flops_per_iter / secs_per_iter / 1e12 if flops_per_iter else None
@@ -215,6 +227,7 @@ def main() -> None:
         if tflops and peak_tflops:
             entry["mfu_vs_peak"] = round(tflops / peak_tflops, 4)
         details[name] = entry
+        flush_details()
         _log(f"{name}: {entry['value']} {unit} "
              f"({entry['sec_per_iter']}s/iter, {entry['achieved_tflops_per_sec']} TFLOP/s, "
              f"sync {sync * 1e3:.0f}ms)")
@@ -378,33 +391,40 @@ def main() -> None:
 
         def bench_e2e(name, ex, warm_fn, feat_key, unit_key=None):
             _log(f"{name}: compiling on synthetic batches")
-            warm_fn()
-            clock = StageClock()
-            ex.clock = clock
-            if ex.cfg.decode_workers > 1 and ex.uses_frame_stream:
-                # the pool is normally created by run(); replicate its
-                # schedule-ahead window for the direct extract() calls
-                from video_features_tpu.parallel.pipeline import DecodePrefetcher
+            try:
+                warm_fn()
+                clock = StageClock()
+                ex.clock = clock
+                if ex.cfg.decode_workers > 1 and ex.uses_frame_stream:
+                    # the pool is normally created by run(); replicate its
+                    # schedule-ahead window for the direct extract() calls
+                    from video_features_tpu.parallel.pipeline import DecodePrefetcher
 
-                ex._decode_pool = DecodePrefetcher(ex._open_inline,
-                                                   ex.cfg.decode_workers)
+                    ex._decode_pool = DecodePrefetcher(ex._open_inline,
+                                                       ex.cfg.decode_workers)
+                    for v in videos:
+                        ex._decode_pool.schedule(v)
+                total_units = 0
+                t0 = time.perf_counter()
                 for v in videos:
-                    ex._decode_pool.schedule(v)
-            total_units = 0
-            t0 = time.perf_counter()
-            for v in videos:
-                try:
-                    out = ex.extract(v)
-                finally:
-                    if ex._decode_pool is not None:
-                        ex._decode_pool.release(v)
-                n = out[feat_key].shape[0]
-                total_units += n
-            wall = time.perf_counter() - t0
-            if ex._decode_pool is not None:
-                ex._decode_pool.shutdown()
-                ex._decode_pool = None
-            ex.clock = None
+                    try:
+                        out = ex.extract(v)
+                    finally:
+                        if ex._decode_pool is not None:
+                            ex._decode_pool.release(v)
+                    n = out[feat_key].shape[0]
+                    total_units += n
+                wall = time.perf_counter() - t0
+            except Exception as e:  # noqa: BLE001 — per-config fault barrier
+                details[name] = {"failed": str(e)[:300]}
+                flush_details()
+                _log(f"{name}: FAILED — {str(e)[:160]}")
+                return
+            finally:
+                if ex._decode_pool is not None:
+                    ex._decode_pool.shutdown()
+                    ex._decode_pool = None
+                ex.clock = None
             entry = {
                 "videos_per_sec": round(len(videos) / wall, 4),
                 "unit": unit_key or f"{feat_key} rows",
@@ -414,6 +434,7 @@ def main() -> None:
                 "device_wait_sec": round(clock.seconds.get("device_wait", 0.0), 3),
             }
             details[name] = entry
+            flush_details()
             _log(f"{name}: {entry['videos_per_sec']} videos/s "
                  f"({entry['units_per_sec']} {entry['unit']}/s; decode "
                  f"{entry['decode_sec']}s, device_wait {entry['device_wait_sec']}s "
@@ -468,10 +489,8 @@ def main() -> None:
     except Exception:
         pass
 
-    # CPU smoke runs must not clobber the recorded TPU measurement
-    name = "bench_details.json" if not on_cpu else "bench_details_cpu_smoke.json"
-    with open(os.path.join(REPO, name), "w") as f:
-        json.dump(details, f, indent=2)
+    # CPU smoke runs write a separate file (see details_name above)
+    flush_details()
 
     value = headline["value"]
     print(

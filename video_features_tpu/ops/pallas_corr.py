@@ -54,7 +54,13 @@ def corr81_xla(f1: jnp.ndarray, f2: jnp.ndarray) -> jnp.ndarray:
         for dx in range(-r, r + 1):
             shifted = f2p[:, r + dy : r + dy + h, r + dx : r + dx + w, :].astype(jnp.float32)
             taps.append(jnp.mean(f1 * shifted, axis=-1))
-    return jnp.stack(taps, axis=-1).astype(dtype)
+    # stack taps on axis 1 then move to the channel position: stacking 81
+    # single-channel (…, 1) arrays directly on the minor axis makes XLA pad
+    # each temp to the 128-lane tile — a 128× memory blowup that OOM'd the
+    # 64-pair I3D sandwich at 256×341 (15.8 GiB of f32[64,64,96,1] copies).
+    # With W as the minor dim the temps pad ≤1.34× and one cheap relayout
+    # produces the (B, H, W, 81) the decoders consume.
+    return jnp.moveaxis(jnp.stack(taps, axis=1), 1, -1).astype(dtype)
 
 
 def _corr81_kernel(f1_ref, f2p_ref, out_ref):
