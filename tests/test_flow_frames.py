@@ -61,3 +61,24 @@ def test_pwc_forward_frames_clip_batch_no_cross_clip_pairs():
     for i in range(2):
         single = np.asarray(pwc_forward_frames(params, clips[i]))
         np.testing.assert_allclose(batched[i], single, rtol=1e-4, atol=1e-4)
+
+
+def test_pwc_pair_chunk_matches_unchunked():
+    """lax.map pair chunking must reproduce the single-piece decode exactly
+    (the shared pyramid is identical; only decoder batching changes)."""
+    rng = np.random.default_rng(15)
+    params = pwc_init_params(0)
+    frames = jnp.asarray(rng.uniform(0, 255, (5, 64, 64, 3)).astype(np.float32))
+    whole = np.asarray(pwc_forward_frames(params, frames))
+    chunked = np.asarray(pwc_forward_frames(params, frames, pair_chunk=2))
+    assert chunked.shape == whole.shape == (4, 64, 64, 2)
+    # 1e-4: conv reduction order varies with the decoder batch size (same
+    # tolerance as the other batch-variant equivalence tests in this file)
+    np.testing.assert_allclose(chunked, whole, rtol=1e-4, atol=1e-4)
+    # non-divisible chunk zero-pads the pair axis and slices — the HBM
+    # protection must never silently disengage on an odd pair count
+    padded = np.asarray(pwc_forward_frames(params, frames, pair_chunk=3))
+    np.testing.assert_allclose(padded, whole, rtol=1e-4, atol=1e-4)
+    # chunk >= total degenerates to the single-piece decode
+    big = np.asarray(pwc_forward_frames(params, frames, pair_chunk=64))
+    np.testing.assert_array_equal(big, whole)

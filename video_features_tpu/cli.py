@@ -75,6 +75,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "or force volume / volume_gather / on_demand")
     parser.add_argument("--pwc_corr", choices=["xla", "pallas"], default="xla",
                         help="PWC cost-volume implementation")
+    parser.add_argument("--flow_pair_chunk", type=int, default=None,
+                        help="i3d flow sandwich: decode PWC pairs in sub-batches "
+                             "of this size to bound HBM (default: auto; 0 = never)")
     parser.add_argument("--decode_workers", type=int, default=1,
                         help="background threads decoding upcoming videos while the "
                              "device computes (frame-stream models); 1 = inline")

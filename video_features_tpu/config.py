@@ -77,6 +77,11 @@ class ExtractionConfig:
     # PWC cost volume: "xla" fused formulation (default) or the "pallas" tile
     # kernel (ops/pallas_corr).
     pwc_corr: str = "xla"
+    # I3D flow sandwich: decode the PWC pairs in sub-batches of this size
+    # under lax.map to bound peak decoder memory (the 64-pair stack at the
+    # sample videos' 256×341 geometry exceeds HBM in one piece). None = auto
+    # (chunk to 16 when pairs × flow-grid area is large); 0 = never chunk.
+    flow_pair_chunk: Optional[int] = None
     # Flow models: replicate-pad frames up to multiples of this size before the
     # device step (flow unpadded after), so a mixed-resolution corpus compiles
     # one program per BUCKET instead of one per distinct video geometry (tunnel
@@ -137,6 +142,8 @@ class ExtractionConfig:
             raise ValueError("matmul_precision must be default|high|highest")
         if self.decode_workers < 1:
             raise ValueError("decode_workers must be >= 1")
+        if self.flow_pair_chunk is not None and self.flow_pair_chunk < 0:
+            raise ValueError("flow_pair_chunk must be >= 0 (0 = never chunk)")
         if self.use_ffmpeg not in ("auto", "always", "never"):
             raise ValueError("use_ffmpeg must be auto|always|never")
         if self.shape_bucket is not None and (

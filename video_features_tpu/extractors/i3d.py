@@ -147,6 +147,7 @@ class ExtractI3D(Extractor):
                       else jnp.float32)
         raft_corr = self.cfg.raft_corr
         pwc_corr = self.cfg.pwc_corr
+        flow_pair_chunk = self.cfg.flow_pair_chunk
 
         def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
             n, sp1, h, w, _c = stacks_u8.shape
@@ -166,8 +167,21 @@ class ExtractI3D(Extractor):
                     flow_params, jnp.pad(frames, pads, mode="edge"),
                     corr_impl=raft_corr, dtype=flow_dtype)
             else:
+                total = n * (sp1 - 1)
+                if flow_pair_chunk is not None:
+                    chunk = flow_pair_chunk or None  # 0 → never chunk
+                else:
+                    # auto: the per-pair decoder working set scales with the
+                    # /64 flow grid (PWC's internal geometry, models/pwc.py
+                    # _grid64); 64 pairs at 256×384 exceeds HBM while 64 at
+                    # 256² fits (BASELINE.md round-3 note)
+                    from ..models.pwc import _grid64
+
+                    h64, w64 = _grid64(h, w)
+                    chunk = 16 if total * h64 * w64 > 5_000_000 else None
                 flow = pwc_forward_frames(flow_params, frames,
-                                          corr_impl=pwc_corr, dtype=flow_dtype)
+                                          corr_impl=pwc_corr, dtype=flow_dtype,
+                                          pair_chunk=chunk)
             # flow: (N, S, Hp, Wp, 2)
             x = i3d_preprocess_flow(_center_crop_nhwc(flow, CROP_SIZE), dtype=dtype)
             feats = model.apply({"params": params}, x, features=True)

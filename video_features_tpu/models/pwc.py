@@ -29,6 +29,7 @@ from __future__ import annotations
 import math
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -141,7 +142,8 @@ def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
 
 
 def pwc_forward_frames(params: Dict, frames: jnp.ndarray,
-                       corr_impl: str = "xla", dtype=jnp.float32) -> jnp.ndarray:
+                       corr_impl: str = "xla", dtype=jnp.float32,
+                       pair_chunk: int = None) -> jnp.ndarray:
     """Flow for all consecutive frame pairs, sharing per-frame features.
 
     ``frames``: (F, H, W, 3) → (F−1, H, W, 2), or a clip batch (N, F, H, W, 3)
@@ -172,7 +174,35 @@ def pwc_forward_frames(params: Dict, frames: jnp.ndarray,
 
     pyr1 = tuple(pairs(p, True) for p in pyr)
     pyr2 = tuple(pairs(p, False) for p in pyr)
-    flow = _decode(params, pyr1, pyr2, h, w, h64, w64, corr_impl)
+    total = n * (f - 1)
+    chunk = min(pair_chunk, total) if pair_chunk else 0
+    if chunk > 0 and chunk < total:
+        # bound peak decoder memory: the DenseNet decoder activations scale
+        # with the pair batch (a 64-pair 65-frame I3D stack at 256×341 blows
+        # HBM in one piece — BASELINE.md round-3 note); the shared per-frame
+        # pyramid above is computed ONCE either way, only the coarse-to-fine
+        # decode runs chunk-by-chunk under lax.map (sequential on device).
+        # Non-divisible totals zero-pad the pair axis up to a chunk multiple
+        # (padded rows decode to garbage and are sliced off) — the protection
+        # must never silently disengage on an odd pair count.
+        def chunked(level_maps):
+            p1, p2 = level_maps
+            return _decode(params, p1, p2, h, w, h64, w64, corr_impl)
+
+        nch = -(-total // chunk)
+        pad = nch * chunk - total
+
+        def to_chunks(p):
+            if pad:
+                p = jnp.concatenate(
+                    [p, jnp.zeros((pad,) + p.shape[1:], p.dtype)], axis=0)
+            return p.reshape((nch, chunk) + p.shape[1:])
+
+        flow = jax.lax.map(chunked, (tuple(to_chunks(p) for p in pyr1),
+                                     tuple(to_chunks(p) for p in pyr2)))
+        flow = flow.reshape((nch * chunk, h, w, 2))[:total]
+    else:
+        flow = _decode(params, pyr1, pyr2, h, w, h64, w64, corr_impl)
     return flow.reshape(lead[:-1] + (f - 1, h, w, 2))
 
 
