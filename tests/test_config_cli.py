@@ -125,6 +125,28 @@ def test_cli_flow_dtype_and_use_ffmpeg():
     assert d.flow_dtype == "float32" and d.use_ffmpeg == "auto"
 
 
+def test_cli_i3d_geometry_knobs():
+    cfg = parse_args(["--feature_type", "i3d", "--video_paths", "a.mp4",
+                      "--i3d_pre_crop_size", "96", "--i3d_crop_size", "64"])
+    assert cfg.i3d_pre_crop_size == 96
+    assert cfg.i3d_crop_size == 64
+    d = parse_args(["--feature_type", "i3d", "--video_paths", "a.mp4"])
+    assert d.i3d_pre_crop_size == 256 and d.i3d_crop_size == 224
+
+
+def test_config_rejects_bad_i3d_geometry():
+    import pytest
+
+    from video_features_tpu.config import ExtractionConfig
+
+    with pytest.raises(ValueError):
+        ExtractionConfig(feature_type="i3d", i3d_crop_size=16).validate()
+    with pytest.raises(ValueError):
+        ExtractionConfig(
+            feature_type="i3d", i3d_pre_crop_size=64, i3d_crop_size=96
+        ).validate()
+
+
 def test_config_rejects_bad_flow_dtype_and_ffmpeg():
     import pytest
 

@@ -63,6 +63,29 @@ def test_extract_two_stream_pwc(tmp_path, sample_video):
     assert not np.allclose(feats["rgb"], feats["flow"])
 
 
+def test_shrunk_geometry_runs_production_steps(tmp_path):
+    """cfg.i3d_pre_crop_size/i3d_crop_size shrink the SAME jitted two-stream
+    programs (the driver dryrun contract, __graft_entry__.dryrun_multichip)."""
+    cfg = ExtractionConfig(
+        feature_type="i3d",
+        stack_size=16,
+        step_size=16,
+        flow_type="pwc",
+        i3d_pre_crop_size=96,
+        i3d_crop_size=64,
+        output_path=str(tmp_path),
+    )
+    ex = ExtractI3D(cfg)
+    stacks = np.random.default_rng(0).integers(
+        0, 256, (ex.clips_per_batch, 17, 96, 96, 3), dtype=np.uint8)
+    dev = ex.runner.put(stacks)
+    for stream in ("rgb", "flow"):
+        step = ex._rgb_step if stream == "rgb" else ex._flow_step
+        feats, _ = step(ex.i3d_params[stream], dev)
+        assert np.asarray(feats).shape == (ex.clips_per_batch, 1024)
+        assert np.isfinite(np.asarray(feats)).all()
+
+
 def test_sliding_window_overlap(tmp_path, sample_video):
     """step < stack: windows overlap, count follows the flow_stack_plan math."""
     from video_features_tpu.utils.windows import flow_stack_plan

@@ -53,6 +53,83 @@ def test_corr81_dispatcher(fmaps):
         corr81(f1, f2, "cuda")
 
 
+def test_corr81_pallas_bf16(fmaps):
+    """bf16 features: both kernels accumulate fp32 in-kernel and store bf16 —
+    must match the XLA formulation's bf16 output within bf16 rounding."""
+    from video_features_tpu.ops.pallas_corr import corr81_pallas_tiled
+
+    f1, f2 = (x.astype(jnp.bfloat16) for x in fmaps)
+    ref = np.asarray(corr81_xla(f1, f2), dtype=np.float32)
+    out = np.asarray(corr81_pallas(f1, f2, interpret=True))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.float32(out), ref, rtol=0.02, atol=0.02)
+    big1 = jnp.concatenate([f1, f1], axis=1)  # 24 rows: forces the tiled path
+    big2 = jnp.concatenate([f2, f2], axis=1)
+    ref_big = np.asarray(corr81_xla(big1, big2), dtype=np.float32)
+    out_big = np.asarray(corr81_pallas_tiled(big1, big2, interpret=True))
+    assert out_big.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.float32(out_big), ref_big, rtol=0.02, atol=0.02)
+
+
+def test_corr81_auto_dispatch(fmaps):
+    """'auto' must be accepted and equal xla on CPU (non-TPU falls back)."""
+    f1, f2 = fmaps
+    np.testing.assert_array_equal(
+        np.asarray(corr81(f1, f2, "auto")), np.asarray(corr81(f1, f2, "xla")))
+
+
+def test_warp_corr81_fused_matches_composition(rng):
+    """Fused warp+corr kernel (interpreter) == warp_backward → corr81_xla,
+    including out-of-bounds flow (partial-tap zeroing) and a non-multiple-of-
+    16 geometry (tile padding)."""
+    from video_features_tpu.ops.pallas_corr import warp_corr81, warp_corr81_pallas
+    from video_features_tpu.ops.warp import warp_backward
+
+    for h, w in ((24, 40), (20, 28)):
+        f1 = jnp.asarray(rng.normal(size=(2, h, w, 16)).astype(np.float32))
+        f2 = jnp.asarray(rng.normal(size=(2, h, w, 16)).astype(np.float32))
+        # flows spanning in-bounds, fractional, and far out-of-bounds targets
+        flow = jnp.asarray(rng.uniform(-10, 10, (2, h, w, 2)).astype(np.float32))
+        ref = np.asarray(corr81_xla(f1, warp_backward(f2, flow)))
+        out = np.asarray(warp_corr81_pallas(f1, f2, flow, interpret=True))
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+        # dispatcher: xla impl is the composition; interpret impl the kernel
+        np.testing.assert_allclose(
+            np.asarray(warp_corr81(f1, f2, flow, "xla")), ref, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(warp_corr81(f1, f2, flow, "pallas_interpret")), ref,
+            rtol=1e-4, atol=1e-5)
+
+
+def test_warp_corr81_fused_bf16(rng):
+    """bf16 features through the fused kernel: fp32 accumulation in-kernel,
+    bf16 store — matches the bf16 composition within bf16 rounding."""
+    from video_features_tpu.ops.pallas_corr import warp_corr81_pallas
+    from video_features_tpu.ops.warp import warp_backward
+
+    f1 = jnp.asarray(rng.normal(size=(1, 24, 24, 16))).astype(jnp.bfloat16)
+    f2 = jnp.asarray(rng.normal(size=(1, 24, 24, 16))).astype(jnp.bfloat16)
+    flow = jnp.asarray(rng.uniform(-6, 6, (1, 24, 24, 2)).astype(np.float32))
+    ref = np.asarray(corr81_xla(f1, warp_backward(f2, flow)), dtype=np.float32)
+    out = np.asarray(warp_corr81_pallas(f1, f2, flow, interpret=True))
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.float32(out), ref, rtol=0.03, atol=0.03)
+
+
+def test_warp_corr81_zero_flow_is_plain_corr(rng):
+    """Zero flow degenerates to corr81 of (f1, f2) away from the border (the
+    warp zeroes nothing in-bounds; border pixels differ only where corr taps
+    read beyond the image, which both paths zero-pad identically)."""
+    from video_features_tpu.ops.pallas_corr import warp_corr81_pallas
+
+    f1 = jnp.asarray(rng.normal(size=(1, 32, 32, 8)).astype(np.float32))
+    f2 = jnp.asarray(rng.normal(size=(1, 32, 32, 8)).astype(np.float32))
+    flow = jnp.zeros((1, 32, 32, 2), jnp.float32)
+    ref = np.asarray(corr81_xla(f1, f2))
+    out = np.asarray(warp_corr81_pallas(f1, f2, flow, interpret=True))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_pwc_forward_pallas_corr_matches(rng):
     """End-to-end PWC flow with the Pallas cost volume == XLA cost volume."""
     from video_features_tpu.models.pwc import pwc_forward, pwc_init_params

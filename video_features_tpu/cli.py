@@ -73,11 +73,24 @@ def build_parser() -> argparse.ArgumentParser:
                              "would outgrow HBM for the frame size, then the "
                              "on-demand alt_cuda_corr equivalent (O(H*W) memory); "
                              "or force volume / volume_gather / on_demand")
-    parser.add_argument("--pwc_corr", choices=["xla", "pallas"], default="xla",
-                        help="PWC cost-volume implementation")
+    parser.add_argument("--pwc_corr", choices=["auto", "xla", "pallas"],
+                        default="auto",
+                        help="PWC cost-volume implementation: auto picks the "
+                             "Pallas tile kernel where its VMEM gate admits "
+                             "the shape, else the fused XLA formulation")
     parser.add_argument("--flow_pair_chunk", type=int, default=None,
                         help="i3d flow sandwich: decode PWC pairs in sub-batches "
-                             "of this size to bound HBM (default: auto; 0 = never)")
+                             "of this size to bound HBM (default: auto; 0 = never; "
+                             "PWC only — the RAFT sandwich bounds memory via "
+                             "--raft_corr auto instead)")
+    parser.add_argument("--i3d_pre_crop_size", type=int, default=256,
+                        help="i3d smaller-edge resize target (reference: 256); "
+                             "override only for CI/dry runs — non-default values "
+                             "change features")
+    parser.add_argument("--i3d_crop_size", type=int, default=224,
+                        help="i3d center-crop size (reference: 224); override "
+                             "only for CI/dry runs — non-default values change "
+                             "features")
     parser.add_argument("--decode_workers", type=int, default=1,
                         help="background threads decoding upcoming videos while the "
                              "device computes (frame-stream models); 1 = inline")

@@ -74,9 +74,11 @@ class ExtractionConfig:
     # alt_cuda_corr equivalent — O(H·W·D) memory instead of O((H·W)²));
     # explicit "volume"/"volume_gather"/"on_demand" force a path.
     raft_corr: str = "auto"
-    # PWC cost volume: "xla" fused formulation (default) or the "pallas" tile
-    # kernel (ops/pallas_corr).
-    pwc_corr: str = "xla"
+    # PWC cost volume: "auto" (default) picks the Pallas tile kernel where its
+    # VMEM gates admit the shape (measured faster at production shapes,
+    # bench_details.json pwc_pairs_*) and the fused XLA formulation elsewhere;
+    # "xla"/"pallas" force a path (ops/pallas_corr).
+    pwc_corr: str = "auto"
     # I3D flow sandwich: decode the PWC pairs in sub-batches of this size
     # under lax.map to bound peak decoder memory (the 64-pair stack at the
     # sample videos' 256×341 geometry exceeds HBM in one piece). None = auto
@@ -105,6 +107,13 @@ class ExtractionConfig:
     # TPU fp32 convs default to bf16 MXU passes; "highest" gives true-fp32
     # accumulation for the bit-parity path (None = XLA default).
     matmul_precision: Optional[str] = None
+    # I3D geometry: smaller-edge resize target and center-crop size. The
+    # reference hard-codes 256/224 (extract_i3d.py:25 + transforms); these stay
+    # the parity defaults. Overriding shrinks the SAME jitted two-stream
+    # programs for CI/dry runs (the driver's dryrun_multichip runs the real
+    # sandwich at 96/64 so it fits a 1-core host's wall-clock budget).
+    i3d_pre_crop_size: int = 256
+    i3d_crop_size: int = 224
 
     def validate(self) -> None:
         """Mirror the reference ``sanity_check`` (``utils/utils.py:88-105``)."""
@@ -136,8 +145,8 @@ class ExtractionConfig:
             raise ValueError("flow_dtype must be float32|bfloat16")
         if self.raft_corr not in ("auto", "volume", "volume_gather", "on_demand"):
             raise ValueError("raft_corr must be auto|volume|volume_gather|on_demand")
-        if self.pwc_corr not in ("xla", "pallas"):
-            raise ValueError("pwc_corr must be 'xla' or 'pallas'")
+        if self.pwc_corr not in ("auto", "xla", "pallas"):
+            raise ValueError("pwc_corr must be auto|xla|pallas")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError("matmul_precision must be default|high|highest")
         if self.decode_workers < 1:
@@ -150,6 +159,10 @@ class ExtractionConfig:
             self.shape_bucket < 8 or self.shape_bucket % 8
         ):
             raise ValueError("shape_bucket must be a multiple of 8 (RAFT /8 contract)")
+        if self.i3d_crop_size < 32:
+            raise ValueError("i3d_crop_size must be >= 32 (five /2 stages)")
+        if self.i3d_pre_crop_size < self.i3d_crop_size:
+            raise ValueError("i3d_pre_crop_size must be >= i3d_crop_size")
 
     def replace(self, **kw) -> "ExtractionConfig":
         return dataclasses.replace(self, **kw)

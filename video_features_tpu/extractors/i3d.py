@@ -48,8 +48,8 @@ from ..weights.convert_torch import convert_i3d, convert_pwc, convert_raft
 from ..weights.store import resolve_params
 from .base import Extractor, pad_batch
 
-PRE_CROP_SIZE = 256
-CROP_SIZE = 224
+# Reference geometry (256-edge resize, 224 center crop — extract_i3d.py:25 +
+# transforms) lives in config.py as the i3d_pre_crop_size/i3d_crop_size defaults.
 
 
 def _center_crop_nhwc(x: jnp.ndarray, size: int) -> jnp.ndarray:
@@ -70,6 +70,8 @@ class ExtractI3D(Extractor):
         self.stack_size = cfg.stack_size
         self.step_size = cfg.step_size
         self.flow_type = cfg.flow_type
+        self.pre_crop_size = cfg.i3d_pre_crop_size
+        self.crop_size = cfg.i3d_crop_size
         # stacks per device step, rounded to a multiple of the mesh size
         self.clips_per_batch = self.runner.device_batch(cfg.clips_per_batch)
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
@@ -91,6 +93,10 @@ class ExtractI3D(Extractor):
             for s in self.streams
         }
         if "flow" in self.streams:
+            if cfg.flow_pair_chunk is not None and self.flow_type == "raft":
+                print("--flow_pair_chunk is PWC-only and ignored with "
+                      "--flow_type raft (RAFT bounds flow memory via "
+                      "--raft_corr auto)")
             if self.flow_type == "raft":
                 self.flow_params = resolve_params(
                     "raft-sintel", convert_torch_fn=convert_raft,
@@ -112,7 +118,7 @@ class ExtractI3D(Extractor):
 
         model = self.i3d[stream]
         c = 3 if stream == "rgb" else 2
-        dummy = jnp.zeros((1, 16, CROP_SIZE, CROP_SIZE, c))
+        dummy = jnp.zeros((1, 16, self.crop_size, self.crop_size, c))
         init = lambda r, d: model.init(r, d, features=False)  # noqa: E731
         return random_params_like(init, jax.random.PRNGKey(0), dummy)["params"]
 
@@ -123,11 +129,12 @@ class ExtractI3D(Extractor):
         model = self.i3d["rgb"]
         with_pred = self.cfg.show_pred
         dtype = self.dtype
+        crop = self.crop_size
 
         def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
             x = i3d_preprocess_rgb(
-                _center_crop_nhwc(stacks_u8[:, :-1], CROP_SIZE), dtype=dtype
-            )  # (N, S, 224, 224, 3)
+                _center_crop_nhwc(stacks_u8[:, :-1], crop), dtype=dtype
+            )  # (N, S, crop, crop, 3)
             feats = model.apply({"params": params}, x, features=True)
             if with_pred:
                 _, logits = model.apply({"params": params}, x, features=False)
@@ -148,6 +155,7 @@ class ExtractI3D(Extractor):
         raft_corr = self.cfg.raft_corr
         pwc_corr = self.cfg.pwc_corr
         flow_pair_chunk = self.cfg.flow_pair_chunk
+        crop = self.crop_size
 
         def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
             n, sp1, h, w, _c = stacks_u8.shape
@@ -183,7 +191,7 @@ class ExtractI3D(Extractor):
                                           corr_impl=pwc_corr, dtype=flow_dtype,
                                           pair_chunk=chunk)
             # flow: (N, S, Hp, Wp, 2)
-            x = i3d_preprocess_flow(_center_crop_nhwc(flow, CROP_SIZE), dtype=dtype)
+            x = i3d_preprocess_flow(_center_crop_nhwc(flow, crop), dtype=dtype)
             feats = model.apply({"params": params}, x, features=True)
             if with_pred:
                 _, logits = model.apply({"params": params}, x, features=False)
@@ -195,7 +203,7 @@ class ExtractI3D(Extractor):
     # --- pipeline -----------------------------------------------------------
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
-        return pil_edge_resize(rgb, PRE_CROP_SIZE)
+        return pil_edge_resize(rgb, self.pre_crop_size)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = self._open_video(video_path)

@@ -34,8 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.nnf import conv2d, conv2d_transpose, leaky_relu
-from ..ops.pallas_corr import corr81
-from ..ops.warp import resize_bilinear_torch, warp_backward
+from ..ops.pallas_corr import corr81, warp_corr81
+from ..ops.warp import resize_bilinear_torch
 
 CORR_RADIUS = 4
 CORR_CHANNELS = (2 * CORR_RADIUS + 1) ** 2  # 81
@@ -75,8 +75,10 @@ def _decoder(p: Dict, level: int, f1: jnp.ndarray, f2: jnp.ndarray, prev,
     else:
         flow = conv2d_transpose(p["moduleUpflow"], prev["flow"])
         upfeat = conv2d_transpose(p["moduleUpfeat"], prev["feat"])
-        warped = warp_backward(f2, flow * DEC_BACKWARD[level])
-        volume = leaky_relu(corr81(f1, warped, corr_impl))
+        # fused warp+correlate (ops/pallas_corr.warp_corr81): under pallas/auto
+        # the warped f2 never exists in HBM — warp gathers were the PWC floor
+        volume = leaky_relu(warp_corr81(f1, f2, flow * DEC_BACKWARD[level],
+                                        corr_impl))
         feat = jnp.concatenate([volume, f1, flow, upfeat], axis=-1)
 
     for name in ("moduleOne", "moduleTwo", "moduleThr", "moduleFou", "moduleFiv"):

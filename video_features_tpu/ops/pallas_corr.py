@@ -68,6 +68,8 @@ def _corr81_kernel(f1_ref, f2p_ref, out_ref):
 
     f1 (1, H, W, C), f2p (1, H+8, W+8, C) → out (1, H, W, 81). The 81 window
     taps are unrolled statically; each is a VPU multiply + lane reduction.
+    Accumulation is fp32 regardless of the feature dtype; the store casts to
+    the output dtype (bf16 forwards keep a bf16 volume downstream).
     """
     f1 = f1_ref[0].astype(jnp.float32)
     h, w, c = f1.shape
@@ -76,7 +78,7 @@ def _corr81_kernel(f1_ref, f2p_ref, out_ref):
         for dx in range(2 * CORR_RADIUS + 1):
             shifted = f2p_ref[0, dy : dy + h, dx : dx + w, :].astype(jnp.float32)
             taps.append(jnp.sum(f1 * shifted, axis=-1) * (1.0 / c))
-    out_ref[0] = jnp.stack(taps, axis=-1)
+    out_ref[0] = jnp.stack(taps, axis=-1).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -92,7 +94,7 @@ def corr81_pallas(f1: jnp.ndarray, f2: jnp.ndarray, interpret: bool = False) -> 
     f2p = jnp.pad(f2, ((0, 0), (r, r), (r, r), (0, 0)))
     return pl.pallas_call(
         _corr81_kernel,
-        out_shape=jax.ShapeDtypeStruct((b, h, w, CORR_CHANNELS), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, h, w, CORR_CHANNELS), f1.dtype),
         grid=(b,),
         in_specs=[
             pl.BlockSpec((1, h, w, c), lambda i: (i, 0, 0, 0)),
@@ -129,7 +131,7 @@ def _corr81_kernel_tiled(f1_ref, f2p_ref, out_ref):
         for dx in range(2 * CORR_RADIUS + 1):
             shifted = tile[dy : dy + _TILE, dx : dx + _TILE, :].astype(jnp.float32)
             taps.append(jnp.sum(f1 * shifted, axis=-1) * (1.0 / c))
-    out_ref[0] = jnp.stack(taps, axis=-1)
+    out_ref[0] = jnp.stack(taps, axis=-1).astype(out_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -152,7 +154,7 @@ def corr81_pallas_tiled(f1: jnp.ndarray, f2: jnp.ndarray,
     hp, wp = h + ph, w + pw
     out = pl.pallas_call(
         _corr81_kernel_tiled,
-        out_shape=jax.ShapeDtypeStruct((b, hp, wp, CORR_CHANNELS), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, hp, wp, CORR_CHANNELS), f1.dtype),
         grid=(b, hp // _TILE, wp // _TILE),
         in_specs=[
             pl.BlockSpec((1, _TILE, _TILE, c), lambda i, j, k: (i, j, k, 0)),
@@ -165,7 +167,7 @@ def corr81_pallas_tiled(f1: jnp.ndarray, f2: jnp.ndarray,
     return out[:, :h, :w, :]
 
 
-def _pallas_tiled_supported(b: int, h: int, w: int, c: int) -> bool:
+def _pallas_tiled_supported(b: int, h: int, w: int, c: int, itemsize: int = 4) -> bool:
     """VMEM gate for the tiled kernel: the resident PER-IMAGE f2p + one
     f1/out block pair, double-buffered, must fit the budget.
 
@@ -174,16 +176,17 @@ def _pallas_tiled_supported(b: int, h: int, w: int, c: int) -> bool:
     block: validated compiled on the axon v5e backend at b=16 × 64² × c32
     (the largest PWC corr level at a 256² input), where a whole-buffer VMEM
     assignment could not possibly fit — so only the per-step working set
-    counts here."""
+    counts here. ``itemsize``: feature bytes (2 for bf16 halves the resident
+    f2p and widens the supported set)."""
     r = CORR_RADIUS
     hp = h + (-h) % _TILE
     wp = w + (-w) % _TILE
-    f2p_bytes = (hp + 2 * r) * (wp + 2 * r) * c * 4
-    blk_bytes = _TILE * _TILE * (c + CORR_CHANNELS) * 4
+    f2p_bytes = (hp + 2 * r) * (wp + 2 * r) * c * itemsize
+    blk_bytes = _TILE * _TILE * (c + CORR_CHANNELS) * itemsize
     return 2 * (f2p_bytes + blk_bytes) <= _VMEM_BUDGET
 
 
-def _pallas_supported(b: int, h: int, w: int, c: int) -> bool:
+def _pallas_supported(b: int, h: int, w: int, c: int, itemsize: int = 4) -> bool:
     """Shape gate for the compiled kernel on the axon v5e backend (observed):
 
     - XLA's memory-space assignment keeps the pallas call's full operands +
@@ -199,12 +202,198 @@ def _pallas_supported(b: int, h: int, w: int, c: int) -> bool:
     if h > 16 or w > 16:
         return False
     r = CORR_RADIUS
-    per_elem = 4 * (h * w * c + (h + 2 * r) * (w + 2 * r) * c + h * w * CORR_CHANNELS)
+    per_elem = itemsize * (
+        h * w * c + (h + 2 * r) * (w + 2 * r) * c + h * w * CORR_CHANNELS)
     return 2 * b * per_elem <= _VMEM_BUDGET
 
 
+# feature dtypes the compiled kernels accept (accumulation is fp32 in-kernel
+# either way; bf16 was parity-checked on the axon v5e backend the same way
+# fp32 was — tests/test_pallas_corr.py exercises both in interpreter mode)
+_KERNEL_DTYPES = (jnp.float32, jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
+# Fused backward-warp + correlation (PWC decoder levels 5..2)
+#
+# The reference composes two CUDA stages: grid_sample-style backward warp of
+# fmap2 by the upsampled flow (pwc_net.py:23-41) then the 81-tap correlation
+# (correlation.py:44-112), materializing the warped fmap2 in HBM between them.
+# The XLA composition additionally lowers the warp's 4 corner gathers to
+# take_along_axis — scalar-unit bound on TPU (docs/architecture.md: the PWC
+# floor). This kernel does both in ONE VMEM pass per 16×16 output tile:
+#
+# - f2 (full image) and the zero-padded flow stay VMEM-resident per image;
+# - the 24×24 haloed warped tile is computed in-kernel: each bilinear corner
+#   is an EXACT one-hot selection matmul (rows have a single 1.0, so even a
+#   bf16 MXU pass reproduces the gathered value bit-for-bit) and the four
+#   fractional weights combine on the VPU — the TPU-native replacement for
+#   the gather (same trick as RAFT's measured 15.5× one-hot window lookup);
+# - the reference's partial-tap zeroing (warped ones-channel ≤ 0.999 → zero
+#   the pixel) falls out of the corner in-bounds weights, no extra pass;
+# - out-of-image halo positions get zero weights automatically, reproducing
+#   the correlation's zero padding;
+# - the 81 taps then run VMEM-resident exactly like _corr81_kernel_tiled.
+# ---------------------------------------------------------------------------
+
+
+def _halo_chunk_rows(hw: int) -> int:
+    """Halo rows per one-hot chunk: keep each (rows·24, H·W) fp32 selection
+    matrix under ~2 MB of VMEM; 24 = _TILE + 2·CORR_RADIUS halo rows total."""
+    halo = _TILE + 2 * CORR_RADIUS
+    for rows in (24, 12, 8, 6, 4, 3, 2, 1):
+        if rows * halo * hw * 4 <= 2 * 1024 * 1024:
+            return rows
+    return 1
+
+
+def _warp_corr81_kernel(f1_ref, f2_ref, flowp_ref, out_ref):
+    """Grid (b, nh, nw): one 16×16 output block per step.
+
+    f1 (1, T, T, C) block; f2 (1, H, W, C) full image (constant block index —
+    VMEM-resident); flowp (1, Hp+8, Wp+8, 2) full zero-padded scaled flow;
+    out (1, T, T, 81).
+    """
+    from jax.experimental import pallas as pl
+
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+    r = CORR_RADIUS
+    halo = _TILE + 2 * r  # 24
+    _, h, w, c = f2_ref.shape
+    hw = h * w
+    f2_flat = f2_ref[0].reshape(hw, c)
+    exact = (jax.lax.Precision.HIGHEST if f2_flat.dtype == jnp.float32
+             else jax.lax.Precision.DEFAULT)  # bf16 selection is exact as-is
+    f1 = f1_ref[0].astype(jnp.float32)
+
+    hc = _halo_chunk_rows(hw)
+    chunks = []
+    for r0 in range(0, halo, hc):
+        rows = min(hc, halo - r0)
+        p = rows * halo
+        # global warped-image coordinates of this halo chunk (may be < 0 or
+        # ≥ H/W on the border tiles — those positions get zero weights below)
+        iy = jax.lax.broadcasted_iota(jnp.float32, (rows, halo), 0)
+        ix = jax.lax.broadcasted_iota(jnp.float32, (rows, halo), 1)
+        gy = (j * _TILE + r0 - r).astype(jnp.float32) + iy
+        gx = (k * _TILE - r).astype(jnp.float32) + ix
+        fl = flowp_ref[0, pl.dslice(j * _TILE + r0, rows),
+                       pl.dslice(k * _TILE, halo), :].astype(jnp.float32)
+        x = gx + fl[..., 0]
+        y = gy + fl[..., 1]
+        x0 = jnp.floor(x)
+        y0 = jnp.floor(y)
+        wx = x - x0
+        wy = y - y0
+        acc = jnp.zeros((p, c), jnp.float32)
+        ones_acc = jnp.zeros((rows, halo), jnp.float32)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (p, hw), 1)
+        for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
+                            (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
+            xi = x0 + dx
+            yi = y0 + dy
+            inb = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
+            idx = (jnp.clip(yi, 0, h - 1) * w + jnp.clip(xi, 0, w - 1)
+                   ).astype(jnp.int32).reshape(p, 1)
+            onehot = (idx == iota).astype(f2_flat.dtype)
+            sel = jax.lax.dot_general(
+                onehot, f2_flat, (((1,), (0,)), ((), ())),
+                precision=exact, preferred_element_type=jnp.float32)
+            wgt_eff = (wgt * inb.astype(jnp.float32)).reshape(p, 1)
+            acc = acc + wgt_eff * sel
+            ones_acc = ones_acc + wgt * inb.astype(jnp.float32)
+        # reference partial-tap zeroing: any out-of-bounds leakage (sampled
+        # ones ≤ 0.999) zeroes the whole pixel (pwc_net.py:36-40)
+        keep = (ones_acc > 0.999).astype(jnp.float32).reshape(p, 1)
+        chunks.append((acc * keep).reshape(rows, halo, c))
+    warped = jnp.concatenate(chunks, axis=0)  # (24, 24, C) fp32
+
+    taps = []
+    for dy in range(2 * r + 1):
+        for dx in range(2 * r + 1):
+            shifted = warped[dy : dy + _TILE, dx : dx + _TILE, :]
+            taps.append(jnp.sum(f1 * shifted, axis=-1) * (1.0 / c))
+    out_ref[0] = jnp.stack(taps, axis=-1).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def warp_corr81_pallas(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Fused ``corr81(f1, warp_backward(f2, flow))`` — flow already scaled.
+
+    Pads H/W to tile multiples (padded f1 rows produce sliced-off outputs;
+    padded flow/out-of-image warp targets get zero weights in-kernel, which
+    IS the correlation's zero padding + the warp's border zeroing).
+    """
+    from jax.experimental import pallas as pl
+
+    b, h, w, c = f1.shape
+    r = CORR_RADIUS
+    ph = (-h) % _TILE
+    pw = (-w) % _TILE
+    hp, wp = h + ph, w + pw
+    f1p = jnp.pad(f1, ((0, 0), (0, ph), (0, pw), (0, 0)))
+    flowp = jnp.pad(flow.astype(jnp.float32),
+                    ((0, 0), (r, r + ph), (r, r + pw), (0, 0)))
+    out = pl.pallas_call(
+        _warp_corr81_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, hp, wp, CORR_CHANNELS), f1.dtype),
+        grid=(b, hp // _TILE, wp // _TILE),
+        in_specs=[
+            pl.BlockSpec((1, _TILE, _TILE, c), lambda i, j, k: (i, j, k, 0)),
+            pl.BlockSpec((1, h, w, c), lambda i, j, k: (i, 0, 0, 0)),
+            pl.BlockSpec((1, hp + 2 * r, wp + 2 * r, 2),
+                         lambda i, j, k: (i, 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _TILE, _TILE, CORR_CHANNELS),
+                               lambda i, j, k: (i, j, k, 0)),
+        interpret=interpret,
+    )(f1p, f2, flowp)
+    return out[:, :h, :w, :]
+
+
+def _warp_corr_supported(b: int, h: int, w: int, c: int, itemsize: int) -> bool:
+    """VMEM gate: resident f2 + padded flow + the per-step working set
+    (one-hot chunk, f1/out blocks, warped halo), double-buffered."""
+    r = CORR_RADIUS
+    hp = h + (-h) % _TILE
+    wp = w + (-w) % _TILE
+    halo = _TILE + 2 * r
+    f2_bytes = h * w * c * itemsize
+    flow_bytes = (hp + 2 * r) * (wp + 2 * r) * 2 * 4
+    onehot_bytes = _halo_chunk_rows(h * w) * halo * h * w * 4
+    work_bytes = (halo * halo * c * 4  # warped tile
+                  + _TILE * _TILE * (c + CORR_CHANNELS) * itemsize)
+    return 2 * (f2_bytes + flow_bytes + onehot_bytes + work_bytes) <= _VMEM_BUDGET
+
+
+def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
+                impl: str = "xla") -> jnp.ndarray:
+    """Backward-warp ``f2`` by ``flow`` (already level-scaled) and correlate.
+
+    ``xla``: the two-stage composition (gather warp → fused-XLA volume).
+    ``auto``/``pallas``: the fused kernel where the VMEM gate admits the
+    shape, else the composition. ``pallas_interpret``: fused kernel in the
+    Pallas interpreter (CPU tests).
+    """
+    from .warp import warp_backward
+
+    if impl == "pallas_interpret":
+        return warp_corr81_pallas(f1, f2, flow, interpret=True)
+    if impl in ("pallas", "auto") and jax.default_backend() == "tpu" \
+            and f1.dtype in _KERNEL_DTYPES:
+        b, h, w, c = f1.shape
+        if _warp_corr_supported(b, h, w, c, jnp.dtype(f1.dtype).itemsize):
+            return warp_corr81_pallas(f1, f2, flow)
+    return corr81(f1, warp_backward(f2, flow), impl)
+
+
 def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
-    """Dispatch: ``xla`` (default), ``pallas``, or ``pallas_interpret`` (tests)."""
+    """Dispatch: ``xla`` (default), ``auto``/``pallas``, or ``pallas_interpret``
+    (tests). ``auto`` picks the measured winner per shape — the Pallas kernels
+    where the VMEM gates admit them (fp32 b2×256²: +43 % over xla, round 3;
+    bf16 validated round 4), the fused XLA formulation everywhere else."""
     if impl == "xla":
         return corr81_xla(f1, f2)
     b, h, w, c = f1.shape
@@ -212,19 +401,21 @@ def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
         if h > _TILE or w > _TILE:
             return corr81_pallas_tiled(f1, f2, interpret=True)
         return corr81_pallas(f1, f2, interpret=True)
-    if impl == "pallas":
-        if jax.default_backend() != "tpu" or f1.dtype != jnp.float32:
-            # Mosaic compiles TPU-only (tests use pallas_interpret); non-fp32
-            # dtypes and non-TPU backends take the XLA path
+    if impl in ("pallas", "auto"):
+        if jax.default_backend() != "tpu" or f1.dtype not in _KERNEL_DTYPES:
+            # Mosaic compiles TPU-only (tests use pallas_interpret);
+            # unsupported dtypes and non-TPU backends take the XLA path
             return corr81_xla(f1, f2)
+        isz = jnp.dtype(f1.dtype).itemsize
         if h <= _TILE and w <= _TILE:
             # small spatial sizes keep the single-block kernel and its
             # empirically calibrated B-scaled budget; shapes it rejects go to
             # XLA (the tiled kernel targets the >16² spatial regime only)
-            if _pallas_supported(b, h, w, c):
+            if _pallas_supported(b, h, w, c, isz):
                 return corr81_pallas(f1, f2)
             return corr81_xla(f1, f2)
-        if _pallas_tiled_supported(b, h, w, c):
+        if _pallas_tiled_supported(b, h, w, c, isz):
             return corr81_pallas_tiled(f1, f2)
         return corr81_xla(f1, f2)
-    raise ValueError(f"unknown corr impl {impl!r}; expected xla|pallas|pallas_interpret")
+    raise ValueError(
+        f"unknown corr impl {impl!r}; expected xla|auto|pallas|pallas_interpret")
