@@ -169,6 +169,13 @@ def main() -> None:
     from video_features_tpu.extractors.i3d import ExtractI3D
     from video_features_tpu.extractors.resnet import ExtractResNet50
 
+    if os.environ.pop("VFT_I3D_TAP_FP32", None) is not None:
+        # a pre-set flag would silently tap-lower every fp32 I3D config,
+        # including the bit-parity headline; bench entries must be single-
+        # lowering — the flag is applied only to i3d_rgb_float32_tapconv
+        _log("VFT_I3D_TAP_FP32 was set in the environment; cleared — bench "
+             "applies it only to the i3d_rgb_float32_tapconv config")
+
     on_cpu = jax.default_backend() == "cpu"
     n_chips = jax.local_device_count()  # extractors mesh over all local devices
     rng = np.random.default_rng(0)
@@ -326,6 +333,29 @@ def main() -> None:
         if dtype == "float32":
             headline = e
             print_summary()  # headline secured — a later kill loses nothing
+
+    # fp32 stem through the TapConv3D lowering (VFT_I3D_TAP_FP32 — joint-
+    # extent convs only; reassociates the temporal sum, hence not the
+    # bit-parity headline). The stem is 21 of 33 ms (docs/architecture.md).
+    if not on_cpu and not over_budget("i3d_rgb_float32_tapconv"):
+        os.environ["VFT_I3D_TAP_FP32"] = "1"
+        try:
+            ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
+                                step_size=stack, clips_per_batch=clips,
+                                dtype="float32"))
+
+            def mk_tap(ex=ex):
+                return (ex.i3d_params["rgb"],
+                        ex.runner.put(rng.integers(
+                            0, 256, (ex.clips_per_batch, stack + 1, 256, 256, 3),
+                            dtype=np.uint8)))
+
+            timing = _time_step(ex._rgb_step, mk_tap, iters, _repeats(on_cpu))
+            record("i3d_rgb_float32_tapconv", timing,
+                   ex.clips_per_batch * stack / 64.0, "clips/sec/chip",
+                   _flops_of(ex._rgb_step, *mk_tap()))
+        finally:
+            del os.environ["VFT_I3D_TAP_FP32"]
 
     # ---- I3D-flow composites: flow net + transform sandwich + I3D, one step ----
     # pwc is the reference's default flow for i3d (main.py:72-73); raft is the
@@ -558,13 +588,18 @@ def main() -> None:
                         rng.uniform(0, 255, (ex.batch_size + 1, h, w, 3))
                         .astype(np.float32))))
 
-            for workers in (1, 4):
-                if over_budget(f"e2e_raft_float32_w{workers}"):
+            # tx16: --transfer_dtype float16 halves the D2H bytes; paired with
+            # the async double-buffered fetch this is the round-4 answer to
+            # the 82 %-device_wait e2e_raft profile
+            for workers, tdt, tag in ((1, "float32", ""), (4, "float32", ""),
+                                      (4, "float16", "_tx16")):
+                name = f"e2e_raft_float32_w{workers}{tag}"
+                if over_budget(name):
                     continue
                 ex = ExtractFlow(cfg("raft", batch_size=16, num_devices=1,
-                                     decode_workers=workers))
-                bench_e2e(f"e2e_raft_float32_w{workers}", ex,
-                          lambda ex=ex: warm_raft(ex), "raft", "pairs")
+                                     decode_workers=workers,
+                                     transfer_dtype=tdt))
+                bench_e2e(name, ex, lambda ex=ex: warm_raft(ex), "raft", "pairs")
 
     # ---- headline line (re-print; first printed right after i3d_rgb) ----------
     if skipped:

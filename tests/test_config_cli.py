@@ -125,6 +125,20 @@ def test_cli_flow_dtype_and_use_ffmpeg():
     assert d.flow_dtype == "float32" and d.use_ffmpeg == "auto"
 
 
+def test_cli_transfer_dtype():
+    cfg = parse_args(["--feature_type", "raft", "--video_paths", "a.mp4",
+                      "--transfer_dtype", "float16"])
+    assert cfg.transfer_dtype == "float16"
+    assert parse_args(["--feature_type", "raft", "--video_paths", "a.mp4"]
+                      ).transfer_dtype == "float32"
+    import pytest
+
+    from video_features_tpu.config import ExtractionConfig
+
+    with pytest.raises(ValueError):
+        ExtractionConfig(feature_type="raft", transfer_dtype="int8").validate()
+
+
 def test_cli_i3d_geometry_knobs():
     cfg = parse_args(["--feature_type", "i3d", "--video_paths", "a.mp4",
                       "--i3d_pre_crop_size", "96", "--i3d_crop_size", "64"])

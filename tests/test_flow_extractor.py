@@ -55,6 +55,51 @@ def test_pair_batching_consistency(extractor):
     np.testing.assert_allclose(chunked, whole, rtol=1e-5, atol=1e-5)
 
 
+def test_transfer_dtype_float16(extractor, tmp_path):
+    """--transfer_dtype float16: fp32 .npy output within the documented
+    quantization of the bit-parity path; async pending queue drains fully."""
+    import os
+
+    os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    cfg = ExtractionConfig(
+        feature_type="raft",
+        output_path=str(tmp_path),
+        batch_size=4,
+        transfer_dtype="float16",
+    )
+    ex16 = ExtractFlow(cfg)
+    rng = np.random.default_rng(1)
+    frames = rng.uniform(0, 255, (9, 64, 72, 3)).astype(np.float32)
+    # fp32 reference path (module extractor: batch 16, pads the 4-pair window)
+    ref = extractor._run_pairs(frames[:5])
+    out = ex16._run_pairs(frames[:5])
+    assert out.dtype == np.float32
+    scale = max(np.abs(ref).max(), 1.0)
+    np.testing.assert_allclose(out, ref, atol=2e-3 * scale)
+
+
+def test_pending_queue_multi_batch(tmp_path, sample_video):
+    """Queue depth must not change results: extract() with many in-flight
+    windows (prefetch_depth 3) is bit-identical to depth 1 — the double-
+    buffered fetch must not reorder, drop, or double-collect windows.
+    (Same batch size → same jitted program → exact equality.)"""
+    mp = pytest.MonkeyPatch()
+    mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    try:
+        shallow = ExtractFlow(ExtractionConfig(
+            feature_type="raft", output_path=str(tmp_path / "a"),
+            batch_size=4, side_size=96, extraction_fps=2, prefetch_depth=1))
+        deep = ExtractFlow(ExtractionConfig(
+            feature_type="raft", output_path=str(tmp_path / "b"),
+            batch_size=4, side_size=96, extraction_fps=2, prefetch_depth=3))
+        fa = shallow.extract(sample_video)["raft"]
+        fb = deep.extract(sample_video)["raft"]
+        assert fa.shape == fb.shape and fa.shape[0] > 8  # several windows
+        np.testing.assert_array_equal(fa, fb)
+    finally:
+        mp.undo()
+
+
 def test_extract_sample_pwc(tmp_path, sample_video):
     mp = pytest.MonkeyPatch()
     mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")

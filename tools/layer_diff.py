@@ -43,8 +43,12 @@ def _diff(name, torch_nchw, flax_nhwc):
     return name, float(np.abs(t - f).max()), float(np.abs(t).max())
 
 
-def i3d_layer_diff(modality="rgb", shape=(1, 16, 64, 64), seed=0):
-    """Layer-wise diffs through the I3D stem + all Mixed blocks."""
+def i3d_layer_diff(modality="rgb", shape=(1, 16, 64, 64), seed=0, sd=None):
+    """Layer-wise diffs through the I3D stem + all Mixed blocks.
+
+    ``sd``: a reference-named torch state dict — pass a REAL pretrained
+    checkpoint's dict to verify it end to end (tools/verify_parity.py);
+    default None uses the deterministic random mirror weights."""
     import torch
 
     from tools.torch_mirrors import i3d_forward, i3d_random_state_dict
@@ -57,7 +61,8 @@ def i3d_layer_diff(modality="rgb", shape=(1, 16, 64, 64), seed=0):
     b, t, h, w = shape
     x = rng.uniform(-1, 1, (b, t, h, w, c)).astype(np.float32)
 
-    sd = i3d_random_state_dict(modality, seed=seed)
+    if sd is None:
+        sd = i3d_random_state_dict(modality, seed=seed)
     taps_t: dict = {}
     i3d_forward(sd, torch.from_numpy(np.moveaxis(x, -1, 1)), features=True, taps=taps_t)
 
@@ -75,11 +80,13 @@ def i3d_layer_diff(modality="rgb", shape=(1, 16, 64, 64), seed=0):
     return rows
 
 
-def raft_layer_diff(shape=(1, 128, 128), iters=4, seed=0):
+def raft_layer_diff(shape=(1, 128, 128), iters=4, seed=0, sd=None):
     # NB: H, W ≥ 128 keeps the coarsest corr-pyramid level ≥ 2×2; at 1×1 the
     # reference's align_corners grid mapping divides by (W−1) = 0 (NaN on both
     # sides — real checkpoints never see inputs that small).
-    """Stage-wise diffs: encoders, correlation volume, per-iteration flow."""
+    """Stage-wise diffs: encoders, correlation volume, per-iteration flow.
+
+    ``sd``: optional REAL reference state dict (see tools/verify_parity.py)."""
     import torch
 
     from tools.torch_mirrors import raft_random_state_dict, raft_torch_forward
@@ -92,7 +99,8 @@ def raft_layer_diff(shape=(1, 128, 128), iters=4, seed=0):
     im1 = rng.uniform(0, 255, (b, h, w, 3)).astype(np.float32)
     im2 = rng.uniform(0, 255, (b, h, w, 3)).astype(np.float32)
 
-    sd = raft_random_state_dict(seed=seed)
+    if sd is None:
+        sd = raft_random_state_dict(seed=seed)
     taps_t: dict = {}
     raft_torch_forward(sd, torch.from_numpy(np.moveaxis(im1, -1, 1)),
                        torch.from_numpy(np.moveaxis(im2, -1, 1)), iters=iters, taps=taps_t)

@@ -1,0 +1,134 @@
+"""Bisect which construct of the fused warp+corr kernel the axon Mosaic
+backend rejects (HTTP 500 = compile-helper subprocess crash, no diagnostics).
+
+Each probe is a minimal pallas_call exercising ONE ingredient; run on TPU:
+    python tools/probe_mosaic.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+H = W = 64
+C = 32
+P = 96  # chunk pixels
+HW = H * W
+
+
+def probe(name, kernel, out_shape, *args):
+    try:
+        out = pl.pallas_call(kernel, out_shape=out_shape)(*args)
+        out.block_until_ready()
+        print(f"{name}: OK", flush=True)
+        return True
+    except Exception as e:  # noqa: BLE001
+        print(f"{name}: FAIL {str(e)[:160]}", flush=True)
+        return False
+
+
+def main():
+    print(f"backend: {jax.default_backend()}", flush=True)
+    rng = np.random.default_rng(0)
+    f2 = jnp.asarray(rng.normal(size=(HW, C)).astype(np.float32))
+    xy = jnp.asarray(rng.uniform(0, 60, (4, 24)).astype(np.float32))  # (rows, halo)
+
+    # 1. int32 iota (P, HW) + compare vs (P, 1) + cast + dot
+    def k1(f2_ref, idx_ref, o_ref):
+        iota = jax.lax.broadcasted_iota(jnp.int32, (P, HW), 1)
+        onehot = (idx_ref[...] == iota).astype(jnp.float32)
+        o_ref[...] = jax.lax.dot_general(
+            onehot, f2_ref[...], (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)
+
+    idx = jnp.asarray(rng.integers(0, HW, (P, 1)).astype(np.int32))
+    probe("onehot_dot_highest", k1,
+          jax.ShapeDtypeStruct((P, C), jnp.float32), f2, idx)
+
+    # 1b. same at DEFAULT precision
+    def k1b(f2_ref, idx_ref, o_ref):
+        iota = jax.lax.broadcasted_iota(jnp.int32, (P, HW), 1)
+        onehot = (idx_ref[...] == iota).astype(jnp.float32)
+        o_ref[...] = jax.lax.dot_general(
+            onehot, f2_ref[...], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    probe("onehot_dot_default", k1b,
+          jax.ShapeDtypeStruct((P, C), jnp.float32), f2, idx)
+
+    # 2. reshape (rows, halo) -> (rows*halo, 1)
+    def k2(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(4 * 24, 1)
+
+    probe("reshape_2d_to_col", k2,
+          jax.ShapeDtypeStruct((96, 1), jnp.float32), xy)
+
+    # 3. floor/clip/astype int32 on 2d
+    def k3(x_ref, o_ref):
+        x0 = jnp.floor(x_ref[...])
+        o_ref[...] = jnp.clip(x0, 0, 63).astype(jnp.int32)
+
+    probe("floor_clip_int", k3,
+          jax.ShapeDtypeStruct((4, 24), jnp.int32), xy)
+
+    # 4. reshape (P, C) -> (rows, halo_c...) back to 3d
+    sel = jnp.asarray(rng.normal(size=(96, C)).astype(np.float32))
+
+    def k4(x_ref, o_ref):
+        o_ref[...] = x_ref[...].reshape(4, 24, C)
+
+    probe("reshape_col_to_3d", k4,
+          jax.ShapeDtypeStruct((4, 24, C), jnp.float32), sel)
+
+    # 5. concatenate along axis 0
+    def k5(x_ref, o_ref):
+        o_ref[...] = jnp.concatenate([x_ref[...], x_ref[...]], axis=0)
+
+    probe("concat_axis0", k5,
+          jax.ShapeDtypeStruct((8, 24), jnp.float32), xy)
+
+    # 6. dynamic slice with program_id-free dslice on a 4d ref
+    flow = jnp.asarray(rng.normal(size=(1, 72, 72, 2)).astype(np.float32))
+
+    def k6(f_ref, o_ref):
+        o_ref[...] = f_ref[0, pl.dslice(4, 4), pl.dslice(0, 24), :]
+
+    probe("dslice_4d", k6,
+          jax.ShapeDtypeStruct((4, 24, 2), jnp.float32), flow)
+
+    # 7. int mod/div on (P,1) iota (alternative to the reshape)
+    def k7(o_ref):
+        pi = jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+        o_ref[...] = (pi // 24) * 100 + pi % 24
+
+    probe("iota_divmod_col", k7, jax.ShapeDtypeStruct((P, 1), jnp.int32))
+
+    # 8. the 81-tap static-shift corr on a (24,24,C) tile (known-good shape
+    #    from _corr81_kernel_tiled, sanity)
+    warped = jnp.asarray(rng.normal(size=(24, 24, C)).astype(np.float32))
+    f1t = jnp.asarray(rng.normal(size=(16, 16, C)).astype(np.float32))
+
+    def k8(w_ref, f1_ref, o_ref):
+        taps = []
+        f1 = f1_ref[...]
+        for dy in range(9):
+            for dx in range(9):
+                taps.append(jnp.sum(
+                    f1 * w_ref[dy:dy + 16, dx:dx + 16, :], axis=-1) / C)
+        o_ref[...] = jnp.stack(taps, axis=-1)
+
+    probe("taps81", k8, jax.ShapeDtypeStruct((16, 16, 81), jnp.float32),
+          warped, f1t)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Measure the fused warp+corr kernel vs the XLA composition on TPU.
+
+Per-level shapes are PWC's correlation inputs at a 256² frame (the production
+two-stream I3D geometry): level ℓ runs at 256/2^ℓ with PYR_CHANNELS[ℓ-1]
+features. Each (impl, dtype, level) is timed with bench.py's methodology
+(fresh inputs per call, forced host read, sync subtraction); results append
+to ``tools/warp_corr_profile.json``.
+
+Run on the axon TPU; compile failures are caught per-config so one Mosaic
+rejection cannot sink the sweep.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+from tools._bench_util import enable_compilation_cache, time_fn  # noqa: E402
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    enable_compilation_cache()
+    print(f"backend: {jax.default_backend()} {jax.devices()[0]}", flush=True)
+
+    from video_features_tpu.ops.pallas_corr import warp_corr81
+    from video_features_tpu.ops.warp import warp_backward
+
+    rng = np.random.default_rng(0)
+    results = {"device": str(jax.devices()[0])}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "warp_corr_profile.json")
+
+    def flush():
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(results, f, indent=2)
+        os.replace(out_path + ".tmp", out_path)
+
+    b = 16
+    # (level, side, channels) at a 256² input; level 6 has no warp
+    levels = ((2, 64, 32), (3, 32, 64), (4, 16, 96), (5, 8, 128))
+
+    import functools
+
+    for level, side, c in levels:
+        for dtype_name, dtype in (("float32", jnp.float32),
+                                  ("bfloat16", jnp.bfloat16)):
+            def mk(side=side, c=c, dtype=dtype):
+                f1 = jnp.asarray(rng.normal(size=(b, side, side, c))
+                                 .astype(np.float32)).astype(dtype)
+                f2 = jnp.asarray(rng.normal(size=(b, side, side, c))
+                                 .astype(np.float32)).astype(dtype)
+                fl = jnp.asarray(rng.uniform(-6, 6, (b, side, side, 2))
+                                 .astype(np.float32))
+                return f1, f2, fl
+
+            for impl in ("xla", "pallas"):
+                name = f"L{level}_{side}x{side}c{c}_{dtype_name}_{impl}"
+                step = jax.jit(functools.partial(warp_corr81, impl=impl))
+                try:
+                    sec = time_fn(name, step, mk, iters=8)
+                    results[name] = round(sec * 1e3, 4)  # ms/iter (b=16)
+                except Exception as e:  # noqa: BLE001 — per-config barrier
+                    results[name] = f"FAILED: {str(e)[:200]}"
+                    print(f"{name}: FAILED {str(e)[:160]}", flush=True)
+                flush()
+
+            # parity of the compiled kernel vs the composition on-device
+            try:
+                f1, f2, fl = mk()
+                ref = np.asarray(
+                    jax.jit(lambda a, b2, fl2: warp_corr81(a, b2, fl2, "xla"))
+                    (f1, f2, fl), dtype=np.float32)
+                out = np.asarray(
+                    jax.jit(lambda a, b2, fl2: warp_corr81(a, b2, fl2, "pallas"))
+                    (f1, f2, fl), dtype=np.float32)
+                err = float(np.max(np.abs(out - ref)))
+                scale = float(np.max(np.abs(ref))) or 1.0
+                results[f"L{level}_{dtype_name}_max_abs_err"] = err
+                print(f"L{level} {dtype_name} parity: max|Δ|={err:.3e} "
+                      f"(max|ref|={scale:.3e})", flush=True)
+            except Exception as e:  # noqa: BLE001
+                results[f"L{level}_{dtype_name}_max_abs_err"] = f"FAILED: {str(e)[:200]}"
+            flush()
+
+    # whole-forward effect: pwc_forward_frames on a 17-frame 256² stack
+    from video_features_tpu.models.pwc import pwc_forward_frames, pwc_init_params
+
+    params = pwc_init_params(seed=0)
+    params = jax.device_put(params)
+    for dtype_name, dtype in (("float32", jnp.float32), ("bfloat16", jnp.bfloat16)):
+        for impl in ("xla", "auto"):
+            name = f"pwc_frames17_256_{dtype_name}_{impl}"
+            step = jax.jit(functools.partial(
+                pwc_forward_frames, corr_impl=impl, dtype=dtype))
+
+            def mk_frames():
+                return (params, jnp.asarray(
+                    rng.uniform(0, 255, (17, 256, 256, 3)).astype(np.float32)))
+
+            try:
+                sec = time_fn(name, step, mk_frames, iters=4)
+                results[name] = round(sec * 1e3, 4)  # ms per 16-pair stack
+            except Exception as e:  # noqa: BLE001
+                results[name] = f"FAILED: {str(e)[:200]}"
+                print(f"{name}: FAILED {str(e)[:160]}", flush=True)
+            flush()
+
+    print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "of this size to bound HBM (default: auto; 0 = never; "
                              "PWC only — the RAFT sandwich bounds memory via "
                              "--raft_corr auto instead)")
+    parser.add_argument("--transfer_dtype", default="float32",
+                        choices=["float32", "float16", "bfloat16"],
+                        help="raft/pwc: cast dense flow to this on device "
+                             "before the host fetch (halves/quarters D2H "
+                             "bytes; host upcasts, .npy outputs stay fp32; "
+                             "float16 quantizes <=0.01 px for |flow|<=32)")
     parser.add_argument("--i3d_pre_crop_size", type=int, default=256,
                         help="i3d smaller-edge resize target (reference: 256); "
                              "override only for CI/dry runs — non-default values "

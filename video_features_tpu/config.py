@@ -107,6 +107,12 @@ class ExtractionConfig:
     # TPU fp32 convs default to bf16 MXU passes; "highest" gives true-fp32
     # accumulation for the bit-parity path (None = XLA default).
     matmul_precision: Optional[str] = None
+    # Dense-flow D2H transfer dtype (raft/pwc extractors): the device casts
+    # the flow before the host fetch and the host upcasts back to fp32 (.npy
+    # outputs stay fp32). "float16" halves the fetched bytes at ≤0.01 px
+    # quantization for |flow| ≤ 32; "bfloat16" at ≤0.16 px for |flow| ≈ 20.
+    # "float32" (default) is bit-parity.
+    transfer_dtype: str = "float32"
     # I3D geometry: smaller-edge resize target and center-crop size. The
     # reference hard-codes 256/224 (extract_i3d.py:25 + transforms); these stay
     # the parity defaults. Overriding shrinks the SAME jitted two-stream
@@ -159,6 +165,8 @@ class ExtractionConfig:
             self.shape_bucket < 8 or self.shape_bucket % 8
         ):
             raise ValueError("shape_bucket must be a multiple of 8 (RAFT /8 contract)")
+        if self.transfer_dtype not in ("float32", "float16", "bfloat16"):
+            raise ValueError("transfer_dtype must be float32|float16|bfloat16")
         if self.i3d_crop_size < 32:
             raise ValueError("i3d_crop_size must be >= 32 (five /2 stages)")
         if self.i3d_pre_crop_size < self.i3d_crop_size:

@@ -14,13 +14,19 @@ Behavioral spec (``/root/reference/models/raft/extract_raft.py``,
 TPU design: pairs are batched into one jitted call with a static pair count (the
 tail batch is padded by repeating its last pair, then trimmed), so each video
 geometry compiles exactly once; host decode overlaps device compute through the
-prefetcher.
+prefetcher. Dense flow is the framework's only D2H-heavy output (full-res
+fp32 maps, not embeddings — ``extract_raft.py:99-101``); the e2e pipeline
+double-buffers the fetch (``copy_to_host_async`` + a bounded pending queue, so
+transfer overlaps both compute and decode) and ``--transfer_dtype float16``
+halves the bytes on the wire (cast on device, upcast on host; outputs stay
+fp32 ``.npy``).
 """
 
 from __future__ import annotations
 
 import functools
 import os
+from collections import deque
 from typing import Dict, List
 
 import numpy as np
@@ -52,6 +58,13 @@ class ExtractFlow(Extractor):
         self.batch_size = self.runner.device_batch(cfg.batch_size)
         self._viz_counter = 0  # --show_pred PNG fallback numbering
         flow_dtype = jnp.bfloat16 if cfg.flow_dtype == "bfloat16" else jnp.float32
+        # D2H transfer dtype: the jitted steps cast their output to this on
+        # device; the host upcasts back to fp32. float16 halves the fetched
+        # bytes at ≤0.01 px quantization for |flow| ≤ 32 (10 mantissa bits);
+        # bfloat16 quarters precision (≤0.16 px at |flow|≈20). float32 is the
+        # bit-parity default.
+        self._transfer_dtype = {"float32": jnp.float32, "float16": jnp.float16,
+                                "bfloat16": jnp.bfloat16}[cfg.transfer_dtype]
         if self.feature_type == "raft":
             self.params = self.runner.put_replicated(
                 resolve_params(
@@ -61,9 +74,11 @@ class ExtractFlow(Extractor):
                 )
             )
             self._forward = functools.partial(
-                raft_forward, corr_impl=cfg.raft_corr, dtype=flow_dtype)
+                raft_forward, corr_impl=cfg.raft_corr, dtype=flow_dtype,
+                n_devices=self.runner.num_devices)
             self._forward_frames = functools.partial(
-                raft_forward_frames, corr_impl=cfg.raft_corr, dtype=flow_dtype)
+                raft_forward_frames, corr_impl=cfg.raft_corr, dtype=flow_dtype,
+                n_devices=self.runner.num_devices)
             self._pads_input = True
         elif self.feature_type == "pwc":
             from ..models.pwc import pwc_forward, pwc_forward_frames, pwc_init_params
@@ -87,32 +102,40 @@ class ExtractFlow(Extractor):
     @functools.cached_property
     def _step(self):
         fwd = self._forward
+        tdt = self._transfer_dtype
 
         # pairs are pre-split on host into (prev, nxt) of equal leading size B so
         # both shard cleanly along the mesh's data axis (a single (B+1,)-frames
         # array cannot: pair i needs frames i and i+1 — a halo across shards)
         def step(params, prev, nxt):  # each (B, H, W, 3) float32
-            return fwd(params, prev, nxt)
+            return fwd(params, prev, nxt).astype(tdt)
 
         return self.runner.jit(step, n_batch_args=2)
 
     @functools.cached_property
     def _frames_step(self):
         fwd = self._forward_frames
+        tdt = self._transfer_dtype
 
         # single-device meshes skip the pair split: (B+1) frames in, each frame
         # encoded once (the pair-split step encodes interior frames twice —
         # the encoder/pyramid is the flow nets' dominant stage)
         def step(params, frames):  # (B+1, H, W, 3) float32
-            return fwd(params, frames)
+            return fwd(params, frames).astype(tdt)
 
         return self.runner.jit(step)
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.cfg.side_size, self.cfg.resize_to_smaller_edge)
 
-    def _run_pairs(self, frames: np.ndarray) -> np.ndarray:
-        """Flow for all consecutive pairs of (N, H, W, 3) float frames → (N-1, 2, H, W)."""
+    def _dispatch_pairs(self, frames: np.ndarray):
+        """Dispatch one pair window to the device; returns an async handle.
+
+        The jitted call returns immediately (JAX async dispatch) and
+        ``copy_to_host_async`` enqueues the D2H transfer right behind the
+        compute, so the fetch rides the DMA engines while the host decodes
+        the next window and the device computes the next batch.
+        """
         n_pairs = frames.shape[0] - 1
         # static shape: pad the window to batch_size+1 frames by repeating the tail
         if n_pairs < self.batch_size:
@@ -130,31 +153,60 @@ class ExtractFlow(Extractor):
             # shared-frame step: every frame encoded once (B+1 frames don't
             # shard evenly over a multi-device mesh, so this is single-chip)
             dev = self.runner.put(np.ascontiguousarray(frames))
-            flow = self._wait(self._frames_step(self.params, dev))
+            flow = self._frames_step(self.params, dev)
         else:
             prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
             nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
-            flow = self._wait(self._step(self.params, prev, nxt))
+            flow = self._step(self.params, prev, nxt)
+        try:
+            flow.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — backends without async host copy
+            pass
+        return flow, n_pairs, pads
+
+    def _collect_pairs(self, handle) -> np.ndarray:
+        """Materialize a dispatched window → (n_pairs, 2, H, W) fp32 host flow."""
+        flow, n_pairs, pads = handle
+        flow = self._wait(flow)
+        if flow.dtype != np.float32:  # transfer_dtype cast: upcast on host
+            flow = flow.astype(np.float32)
         if pads is not None:
             flow = unpad(flow, pads)
         # NHWC → reference byte layout (B, 2, H, W)
         return flow[:n_pairs].transpose(0, 3, 1, 2)
+
+    def _run_pairs(self, frames: np.ndarray) -> np.ndarray:
+        """Flow for all consecutive pairs of (N, H, W, 3) float frames → (N-1, 2, H, W)."""
+        return self._collect_pairs(self._dispatch_pairs(frames))
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = self._open_video(video_path)
         timestamps_ms: List[float] = []
         flow_frames: List[np.ndarray] = []
         window: List[np.ndarray] = []
+        # bounded in-flight device windows: deep enough to overlap fetch with
+        # compute + decode, bounded so a long video can't pin every batch's
+        # full-res flow in HBM
+        pending: deque = deque()
+        max_pending = max(self.cfg.prefetch_depth, 1)
 
         self._viz_counter = 0  # per-video PNG numbering
+
+        def collect_one():
+            stack, handle = pending.popleft()
+            flow = self._collect_pairs(handle)
+            flow_frames.extend(flow)
+            if self.cfg.show_pred:
+                self._show(stack[:-1], flow, video_path)
 
         def flush():
             if len(window) > 1:
                 stack = np.stack(window).astype(np.float32)
-                flow = self._run_pairs(stack)
-                flow_frames.extend(flow)
-                if self.cfg.show_pred:
-                    self._show(stack[:-1], flow, video_path)
+                # the frame stack is only needed again for --show_pred
+                pending.append((stack if self.cfg.show_pred else None,
+                                self._dispatch_pairs(stack)))
+                while len(pending) > max_pending:
+                    collect_one()
 
         for rgb, pos in self._timed_frames(frames_iter):
             timestamps_ms.append(pos)
@@ -163,6 +215,8 @@ class ExtractFlow(Extractor):
                 flush()
                 window = [window[-1]]  # carry last frame (reference :143-146)
         flush()  # final partial batch of ≥ 2 frames (reference :147-151)
+        while pending:
+            collect_one()
 
         h, w = (flow_frames[0].shape[-2:]) if flow_frames else (meta.height, meta.width)
         return {

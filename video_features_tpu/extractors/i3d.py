@@ -156,6 +156,7 @@ class ExtractI3D(Extractor):
         pwc_corr = self.cfg.pwc_corr
         flow_pair_chunk = self.cfg.flow_pair_chunk
         crop = self.crop_size
+        n_devices = self.runner.num_devices
 
         def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
             n, sp1, h, w, _c = stacks_u8.shape
@@ -173,7 +174,8 @@ class ExtractI3D(Extractor):
                         (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
                 flow = raft_forward_frames(
                     flow_params, jnp.pad(frames, pads, mode="edge"),
-                    corr_impl=raft_corr, dtype=flow_dtype)
+                    corr_impl=raft_corr, dtype=flow_dtype,
+                    n_devices=n_devices)
             else:
                 total = n * (sp1 - 1)
                 if flow_pair_chunk is not None:

@@ -175,12 +175,21 @@ def conv3d_module(features: int, kernel: Sequence[int], stride: Sequence[int],
     """The one conv3d chooser (bias-free convs): bf16 routes through
     :class:`TapConv3D` (XLA's conv3d lowering is pathological in bf16 on this
     backend — see TapConv3D's measurements), fp32 keeps ``nn.Conv`` for bit
-    parity. ``padding`` is REQUIRED explicit per-axis (lo, hi) pads — Flax's
-    string "SAME" pads asymmetrically ((2,3) for 7/2) where torch models pad
-    symmetrically, a silent numerics trap no call site should be able to hit.
+    parity. ``VFT_I3D_TAP_FP32=1`` opts the fp32 path into the tap lowering
+    too, but only for kernels with JOINT spatio-temporal extent (kt>1 and
+    kh>1 — the pathological class; R(2+1)D's factored (k,1,1)/(1,k,k) convs
+    measured slower under taps and stay direct) — the taps reassociate the
+    temporal sum (~1e-6 drift), hence opt-in, not default. ``padding`` is
+    REQUIRED explicit per-axis (lo, hi) pads — Flax's string "SAME" pads
+    asymmetrically ((2,3) for 7/2) where torch models pad symmetrically, a
+    silent numerics trap no call site should be able to hit.
     """
+    import os
+
     padding = tuple(tuple(p) for p in padding)
-    if dtype == jnp.bfloat16:
+    joint_extent = kernel[0] > 1 and (kernel[1] > 1 or kernel[2] > 1)
+    tap_fp32 = os.environ.get("VFT_I3D_TAP_FP32") == "1" and joint_extent
+    if dtype == jnp.bfloat16 or tap_fp32:
         return TapConv3D(features, tuple(kernel), tuple(stride), dtype=dtype,
                          padding=padding, name=name)
     return nn.Conv(features, tuple(kernel), strides=tuple(stride),

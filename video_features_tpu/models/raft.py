@@ -49,7 +49,7 @@ _VOLUME_HBM_BUDGET = 4 * 1024**3
 
 
 def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
-                      dtype=jnp.float32) -> str:
+                      dtype=jnp.float32, n_devices: int = 1) -> str:
     """Resolve ``auto`` per frame geometry: the reference-default materialized
     volume while it fits, the O(H·W·D) on-demand path beyond. In fp32 the two
     paths are numerically identical (tested); under ``dtype=bfloat16`` the
@@ -59,6 +59,13 @@ def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
     The pyramid holds ``n_pairs · (h/8·w/8)² · Σ4⁻ˡ`` correlation values
     (corr.py:12-27 geometry); e.g. 16 pairs at 1080p → ~89 GB fp32, several
     times HBM — exactly the case the reference's alt_cuda_corr serves.
+
+    ``n_devices``: mesh size of the surrounding sharded step. Inside a jit the
+    traced ``n_pairs`` is the GLOBAL pair count but each device materializes
+    only its ``n_pairs / n_devices`` shard of the pyramid, so the budget
+    (``VFT_RAFT_VOLUME_BUDGET`` bytes, per device) is compared against the
+    per-device share — without it a mesh-sharded step near the boundary would
+    needlessly take the ~40× slower on-demand path.
     """
     if corr_impl != "auto":
         return corr_impl
@@ -67,7 +74,8 @@ def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
     budget = float(os.environ.get("VFT_RAFT_VOLUME_BUDGET", _VOLUME_HBM_BUDGET))
     q = (h // 8) * (w // 8)
     itemsize = 2 if dtype == jnp.bfloat16 else 4
-    vol_bytes = n_pairs * q * q * itemsize * (1 + 1 / 4 + 1 / 16 + 1 / 64)
+    per_device_pairs = max(1, -(-n_pairs // max(n_devices, 1)))
+    vol_bytes = per_device_pairs * q * q * itemsize * (1 + 1 / 4 + 1 / 16 + 1 / 64)
     return "volume" if vol_bytes <= budget else "on_demand"
 
 # (name, cin, cout, kernel, stride, pad) for plain convs; residual layers described
@@ -339,7 +347,8 @@ def _convex_upsample(flow: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
 
 def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
                  iters: int = ITERS, taps: Dict = None,
-                 corr_impl: str = "volume", dtype=jnp.float32) -> jnp.ndarray:
+                 corr_impl: str = "volume", dtype=jnp.float32,
+                 n_devices: int = 1) -> jnp.ndarray:
     """Flow from frame1 to frame2. Inputs (B, H, W, 3) float RGB in [0, 255],
     H and W divisible by 8. Returns (B, H, W, 2) flow in pixels (u, v).
 
@@ -365,7 +374,8 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     tests/test_flow_bf16.py, docs/architecture.md.
     """
     corr_impl = resolve_corr_impl(corr_impl, image1.shape[0],
-                                  image1.shape[1], image1.shape[2], dtype)
+                                  image1.shape[1], image1.shape[2], dtype,
+                                  n_devices)
     if corr_impl not in ("volume", "volume_gather", "on_demand"):
         raise ValueError(
             f"corr_impl must be auto|volume|volume_gather|on_demand, got {corr_impl!r}")
@@ -379,7 +389,8 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
 
 
 def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
-                        corr_impl: str = "volume", dtype=jnp.float32) -> jnp.ndarray:
+                        corr_impl: str = "volume", dtype=jnp.float32,
+                        n_devices: int = 1) -> jnp.ndarray:
     """Flow for all consecutive frame pairs, sharing per-frame features.
 
     ``frames``: (F, H, W, 3) → (F−1, H, W, 2), or a clip batch (N, F, H, W, 3)
@@ -397,7 +408,8 @@ def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
     n = int(np.prod(lead[:-1], dtype=np.int64)) if len(lead) > 1 else 1
     nf = lead[-1]
     h, w = frames.shape[-3:-1]
-    corr_impl = resolve_corr_impl(corr_impl, n * (nf - 1), h, w, dtype)
+    corr_impl = resolve_corr_impl(corr_impl, n * (nf - 1), h, w, dtype,
+                                  n_devices)
     if corr_impl not in ("volume", "volume_gather", "on_demand"):
         raise ValueError(
             f"corr_impl must be auto|volume|volume_gather|on_demand, got {corr_impl!r}")

@@ -274,8 +274,9 @@ def _warp_corr81_kernel(f1_ref, f2_ref, flowp_ref, out_ref):
         p = rows * halo
         # global warped-image coordinates of this halo chunk (may be < 0 or
         # ≥ H/W on the border tiles — those positions get zero weights below)
-        iy = jax.lax.broadcasted_iota(jnp.float32, (rows, halo), 0)
-        ix = jax.lax.broadcasted_iota(jnp.float32, (rows, halo), 1)
+        # int32 iota + cast: Mosaic's tpu.iota is integer-only
+        iy = jax.lax.broadcasted_iota(jnp.int32, (rows, halo), 0).astype(jnp.float32)
+        ix = jax.lax.broadcasted_iota(jnp.int32, (rows, halo), 1).astype(jnp.float32)
         gy = (j * _TILE + r0 - r).astype(jnp.float32) + iy
         gx = (k * _TILE - r).astype(jnp.float32) + ix
         fl = flowp_ref[0, pl.dslice(j * _TILE + r0, rows),
@@ -286,27 +287,30 @@ def _warp_corr81_kernel(f1_ref, f2_ref, flowp_ref, out_ref):
         y0 = jnp.floor(y)
         wx = x - x0
         wy = y - y0
-        acc = jnp.zeros((p, c), jnp.float32)
+        acc = jnp.zeros((rows, halo, c), jnp.float32)
         ones_acc = jnp.zeros((rows, halo), jnp.float32)
-        iota = jax.lax.broadcasted_iota(jnp.int32, (p, hw), 1)
+        # NB Mosaic reshape rule: only reshapes that PRESERVE the minor (lane)
+        # dim compile on this backend — (rows, halo, hw)→(p, hw) and
+        # (p, c)→(rows, halo, c) are fine, (rows, halo)→(p, 1) is not.
+        iota3 = jax.lax.broadcasted_iota(jnp.int32, (rows, halo, hw), 2)
         for dy, dx, wgt in ((0, 0, (1 - wy) * (1 - wx)), (0, 1, (1 - wy) * wx),
                             (1, 0, wy * (1 - wx)), (1, 1, wy * wx)):
             xi = x0 + dx
             yi = y0 + dy
             inb = ((xi >= 0) & (xi <= w - 1) & (yi >= 0) & (yi <= h - 1))
             idx = (jnp.clip(yi, 0, h - 1) * w + jnp.clip(xi, 0, w - 1)
-                   ).astype(jnp.int32).reshape(p, 1)
-            onehot = (idx == iota).astype(f2_flat.dtype)
+                   ).astype(jnp.int32)
+            onehot = (idx[:, :, None] == iota3).astype(f2_flat.dtype)
             sel = jax.lax.dot_general(
-                onehot, f2_flat, (((1,), (0,)), ((), ())),
+                onehot.reshape(p, hw), f2_flat, (((1,), (0,)), ((), ())),
                 precision=exact, preferred_element_type=jnp.float32)
-            wgt_eff = (wgt * inb.astype(jnp.float32)).reshape(p, 1)
-            acc = acc + wgt_eff * sel
-            ones_acc = ones_acc + wgt * inb.astype(jnp.float32)
+            wgt_eff = wgt * inb.astype(jnp.float32)
+            acc = acc + wgt_eff[:, :, None] * sel.reshape(rows, halo, c)
+            ones_acc = ones_acc + wgt_eff
         # reference partial-tap zeroing: any out-of-bounds leakage (sampled
         # ones ≤ 0.999) zeroes the whole pixel (pwc_net.py:36-40)
-        keep = (ones_acc > 0.999).astype(jnp.float32).reshape(p, 1)
-        chunks.append((acc * keep).reshape(rows, halo, c))
+        keep = (ones_acc > 0.999).astype(jnp.float32)
+        chunks.append(acc * keep[:, :, None])
     warped = jnp.concatenate(chunks, axis=0)  # (24, 24, C) fp32
 
     taps = []
@@ -406,7 +410,9 @@ def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:
             # Mosaic compiles TPU-only (tests use pallas_interpret);
             # unsupported dtypes and non-TPU backends take the XLA path
             return corr81_xla(f1, f2)
-        isz = jnp.dtype(f1.dtype).itemsize
+        # gate on the LARGER operand itemsize: warp_corr81's fallback feeds a
+        # bf16 f1 with an fp32 warped f2, and the resident buffer is f2's
+        isz = max(jnp.dtype(f1.dtype).itemsize, jnp.dtype(f2.dtype).itemsize)
         if h <= _TILE and w <= _TILE:
             # small spatial sizes keep the single-block kernel and its
             # empirically calibrated B-scaled budget; shapes it rejects go to

@@ -190,8 +190,12 @@ class DecodePrefetcher:
                 except queue.Empty:
                     # release()/shutdown() with a full queue can drop their
                     # _DONE sentinel while the stopped worker never enqueues
-                    # one — without this check a late consumer blocks forever
+                    # one — without this check a late consumer blocks forever.
+                    # A stored worker error must still surface on this exit
+                    # path (the dropped sentinel would otherwise swallow it).
                     if slot["stop"].is_set() or self._stop.is_set():
+                        if slot["err"] is not None:
+                            raise slot["err"]
                         return
                     continue
                 if item is self._DONE:
