@@ -402,13 +402,15 @@ def main() -> None:
         record(f"raft_pairs_{flow_dtype}", timing, ex.batch_size, "pairs/sec/chip",
                _flops_of(ex._frames_step, *mk_pairs()), chips=ex.runner.num_devices)
 
-    # ---- PWC dense flow: pairs/sec at 256², xla vs pallas cost volume ---------
-    # the pallas kernel's VMEM working set caps its batch (ops/pallas_corr);
-    # the xla config is also run at the small batch for a like-for-like delta
+    # ---- PWC dense flow: pairs/sec at 256², xla vs auto cost volume -----------
+    # auto = the production default: tiled Pallas volume kernels + the fused
+    # warp+corr kernel where the calibrated gates admit the shape, fused-XLA
+    # elsewhere (ops/pallas_corr). The b2 pair preserves round-3 continuity.
     pwc_configs = [("xla", pairs, "float32")]
     if not on_cpu:
-        pwc_configs += [("xla", pairs, "bfloat16"), ("xla", 2, "float32"),
-                        ("pallas", 2, "float32")]
+        pwc_configs += [("auto", pairs, "float32"),
+                        ("xla", pairs, "bfloat16"), ("auto", pairs, "bfloat16"),
+                        ("xla", 2, "float32"), ("pallas", 2, "float32")]
     for corr, b, flow_dtype in pwc_configs:
         if over_budget(f"pwc_pairs_{flow_dtype}_{corr}_b{b}"):
             continue

@@ -26,19 +26,46 @@ from tools._bench_util import enable_compilation_cache, time_fn  # noqa: E402
 
 
 def main() -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--levels", default="5,4,3,2",
+                    help="comma-separated PWC levels to sweep (subset of 5,4,3,2)")
+    ap.add_argument("--forward", action="store_true",
+                    help="also run the whole-forward xla/auto/auto_nofused sweep")
+    args = ap.parse_args()
+
     import jax
     import jax.numpy as jnp
 
     enable_compilation_cache()
     print(f"backend: {jax.default_backend()} {jax.devices()[0]}", flush=True)
 
-    from video_features_tpu.ops.pallas_corr import warp_corr81
+    # measure the fused kernel DIRECTLY: the production dispatcher's
+    # compile/win allowlist would silently substitute the composition at
+    # gated-out shapes, mislabeling composition numbers as kernel data
+    from video_features_tpu.ops.pallas_corr import (
+        corr81,
+        warp_corr81,
+        warp_corr81_pallas,
+    )
     from video_features_tpu.ops.warp import warp_backward
 
     rng = np.random.default_rng(0)
-    results = {"device": str(jax.devices()[0])}
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "warp_corr_profile.json")
+    device = str(jax.devices()[0])
+    results = {}
+    try:  # merge-update: --levels split runs must not clobber each other —
+        # but only same-device results merge (mixed-provenance timings under
+        # one device key would be worse than a fresh file)
+        with open(out_path) as f:
+            prev = json.load(f)
+        if prev.get("device") == device:
+            results = prev
+    except Exception:
+        pass
+    results["device"] = device
 
     def flush():
         with open(out_path + ".tmp", "w") as f:
@@ -46,8 +73,16 @@ def main() -> None:
         os.replace(out_path + ".tmp", out_path)
 
     b = 16
-    # (level, side, channels) at a 256² input; level 6 has no warp
-    levels = ((2, 64, 32), (3, 32, 64), (4, 16, 96), (5, 8, 128))
+    # (level, side, channels) at a 256² input; level 6 has no warp.
+    # SMALL levels first: the Mosaic remote compile of the 64²/32² kernels
+    # can wedge for 30+ min on the tunnel, and the small levels are the
+    # compile-allowlist candidates — their data must land first.
+    levels_all = {5: (5, 8, 128), 4: (4, 16, 96), 3: (3, 32, 64), 2: (2, 64, 32)}
+    try:
+        levels = tuple(levels_all[int(v)] for v in args.levels.split(","))
+    except (KeyError, ValueError):
+        ap.error(f"--levels must be a comma-separated subset of "
+                 f"{sorted(levels_all)} (got {args.levels!r})")
 
     import functools
 
@@ -63,26 +98,31 @@ def main() -> None:
                                  .astype(np.float32))
                 return f1, f2, fl
 
+            # "pallas" times warp_corr81_pallas DIRECTLY (bypassing the
+            # production allowlist, which would silently substitute the
+            # composition at gated-out shapes); "xla" is the composition
+            steps = {
+                "xla": jax.jit(functools.partial(warp_corr81, impl="xla")),
+                "pallas": jax.jit(warp_corr81_pallas),
+            }
             for impl in ("xla", "pallas"):
                 name = f"L{level}_{side}x{side}c{c}_{dtype_name}_{impl}"
-                step = jax.jit(functools.partial(warp_corr81, impl=impl))
                 try:
-                    sec = time_fn(name, step, mk, iters=8)
+                    sec = time_fn(name, steps[impl], mk, iters=8)
                     results[name] = round(sec * 1e3, 4)  # ms/iter (b=16)
                 except Exception as e:  # noqa: BLE001 — per-config barrier
                     results[name] = f"FAILED: {str(e)[:200]}"
                     print(f"{name}: FAILED {str(e)[:160]}", flush=True)
                 flush()
 
-            # parity of the compiled kernel vs the composition on-device
+            # parity of the compiled fused kernel vs the composition on-device
             try:
                 f1, f2, fl = mk()
                 ref = np.asarray(
                     jax.jit(lambda a, b2, fl2: warp_corr81(a, b2, fl2, "xla"))
                     (f1, f2, fl), dtype=np.float32)
                 out = np.asarray(
-                    jax.jit(lambda a, b2, fl2: warp_corr81(a, b2, fl2, "pallas"))
-                    (f1, f2, fl), dtype=np.float32)
+                    jax.jit(warp_corr81_pallas)(f1, f2, fl), dtype=np.float32)
                 err = float(np.max(np.abs(out - ref)))
                 scale = float(np.max(np.abs(ref))) or 1.0
                 results[f"L{level}_{dtype_name}_max_abs_err"] = err
@@ -92,27 +132,46 @@ def main() -> None:
                 results[f"L{level}_{dtype_name}_max_abs_err"] = f"FAILED: {str(e)[:200]}"
             flush()
 
+    if not args.forward:
+        print(json.dumps({k: v for k, v in results.items()
+                          if not isinstance(v, str)}), flush=True)
+        return
+
     # whole-forward effect: pwc_forward_frames on a 17-frame 256² stack
     from video_features_tpu.models.pwc import pwc_forward_frames, pwc_init_params
 
     params = pwc_init_params(seed=0)
     params = jax.device_put(params)
+    # auto_nofused isolates the fused warp+corr contribution: VFT_FUSED_WARP_CORR=0
+    # keeps the tiled/single-block corr kernels but warps via the XLA gather.
+    # A user-exported VFT_FUSED_WARP_CORR is saved and restored around each
+    # config (it is also a documented external override of the same gate).
+    user_fused = os.environ.get("VFT_FUSED_WARP_CORR")
     for dtype_name, dtype in (("float32", jnp.float32), ("bfloat16", jnp.bfloat16)):
-        for impl in ("xla", "auto"):
-            name = f"pwc_frames17_256_{dtype_name}_{impl}"
-            step = jax.jit(functools.partial(
-                pwc_forward_frames, corr_impl=impl, dtype=dtype))
-
-            def mk_frames():
-                return (params, jnp.asarray(
-                    rng.uniform(0, 255, (17, 256, 256, 3)).astype(np.float32)))
-
+        for impl, tag, fused_env in (("xla", "xla", None),
+                                     ("auto", "auto", None),
+                                     ("auto", "auto_nofused", "0")):
+            name = f"pwc_frames17_256_{dtype_name}_{tag}"
+            if fused_env is not None:
+                os.environ["VFT_FUSED_WARP_CORR"] = fused_env
             try:
+                step = jax.jit(functools.partial(
+                    pwc_forward_frames, corr_impl=impl, dtype=dtype))
+
+                def mk_frames():
+                    return (params, jnp.asarray(
+                        rng.uniform(0, 255, (17, 256, 256, 3)).astype(np.float32)))
+
                 sec = time_fn(name, step, mk_frames, iters=4)
                 results[name] = round(sec * 1e3, 4)  # ms per 16-pair stack
             except Exception as e:  # noqa: BLE001
                 results[name] = f"FAILED: {str(e)[:200]}"
                 print(f"{name}: FAILED {str(e)[:160]}", flush=True)
+            finally:
+                if user_fused is None:
+                    os.environ.pop("VFT_FUSED_WARP_CORR", None)
+                else:
+                    os.environ["VFT_FUSED_WARP_CORR"] = user_fused
             flush()
 
     print(json.dumps(results), flush=True)

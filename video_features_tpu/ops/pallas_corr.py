@@ -372,14 +372,47 @@ def _warp_corr_supported(b: int, h: int, w: int, c: int, itemsize: int) -> bool:
     return 2 * (f2_bytes + flow_bytes + onehot_bytes + work_bytes) <= _VMEM_BUDGET
 
 
+def _fused_compile_ok(h: int, w: int, c: int, dtype) -> bool:
+    """Compile + win allowlist for the fused kernel on the axon v5e backend.
+
+    Two empirical limits (tools/warp_corr_profile.json, round 4):
+
+    - COMPILE: the Mosaic remote compile helper crashes (HTTP 500, no
+      diagnostics) or wedges for 30+ minutes past an undocumented complexity
+      cliff — hw ≤ 256 (PWC levels 5/4 at a 256² input) compiles in seconds
+      in both dtypes; 32² fp32 compiled but bf16 WEDGED; 64² crashes.
+    - WIN: within the compiling set, the fused kernel only beat the
+      composition (gather warp + tiled-corr kernel) at L5 fp32 (+19 %) and
+      L4 bf16 (+28 %); it LOST L4 fp32 (−43 %) and L5 bf16 (−9 %) — so the
+      allowlist is dtype-aware, admitting only the measured winners.
+
+    Like the tiled-corr 16² tile cap the set is empirical and re-measured by
+    ``tools/profile_warp_corr.py`` (which bypasses this gate to reach the
+    kernel). ``VFT_FUSED_WARP_CORR`` forces: "0" disables the fused kernel,
+    "1" bypasses the allowlist (compile hazard: see above).
+    """
+    import os
+
+    force = os.environ.get("VFT_FUSED_WARP_CORR")
+    if force == "0":
+        return False
+    if force == "1":
+        return True
+    if dtype == jnp.bfloat16:
+        return 64 < h * w <= 256
+    return h * w <= 64
+
+
 def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
                 impl: str = "xla") -> jnp.ndarray:
     """Backward-warp ``f2`` by ``flow`` (already level-scaled) and correlate.
 
     ``xla``: the two-stage composition (gather warp → fused-XLA volume).
-    ``auto``/``pallas``: the fused kernel where the VMEM gate admits the
-    shape, else the composition. ``pallas_interpret``: fused kernel in the
-    Pallas interpreter (CPU tests).
+    ``auto``/``pallas``: the fused kernel where the VMEM gate and the compile
+    allowlist admit the shape; otherwise the composition with ``corr81(impl)``
+    — which itself takes the tiled Pallas volume kernel where supported (the
+    round-3 measured win). ``pallas_interpret``: fused kernel in the Pallas
+    interpreter (CPU tests).
     """
     from .warp import warp_backward
 
@@ -388,7 +421,8 @@ def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
     if impl in ("pallas", "auto") and jax.default_backend() == "tpu" \
             and f1.dtype in _KERNEL_DTYPES:
         b, h, w, c = f1.shape
-        if _warp_corr_supported(b, h, w, c, jnp.dtype(f1.dtype).itemsize):
+        if _fused_compile_ok(h, w, c, f1.dtype) and \
+                _warp_corr_supported(b, h, w, c, jnp.dtype(f1.dtype).itemsize):
             return warp_corr81_pallas(f1, f2, flow)
     return corr81(f1, warp_backward(f2, flow), impl)
 
