@@ -55,17 +55,28 @@ def main() -> None:
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "warp_corr_profile.json")
     device = str(jax.devices()[0])
+    import subprocess
+
+    try:
+        code_rev = subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            text=True).strip()
+    except Exception:
+        code_rev = "unknown"
     results = {}
     try:  # merge-update: --levels split runs must not clobber each other —
-        # but only same-device results merge (mixed-provenance timings under
-        # one device key would be worse than a fresh file)
+        # but only same-device SAME-CODE results merge (stale pre-change
+        # kernel timings presented as current data would silently poison the
+        # allowlist calibration)
         with open(out_path) as f:
             prev = json.load(f)
-        if prev.get("device") == device:
+        if prev.get("device") == device and prev.get("code_rev") == code_rev:
             results = prev
     except Exception:
         pass
     results["device"] = device
+    results["code_rev"] = code_rev
 
     def flush():
         with open(out_path + ".tmp", "w") as f:
@@ -100,12 +111,17 @@ def main() -> None:
 
             # "pallas" times warp_corr81_pallas DIRECTLY (bypassing the
             # production allowlist, which would silently substitute the
-            # composition at gated-out shapes); "xla" is the composition
+            # composition at gated-out shapes); "xla" is the gather-warp +
+            # fused-XLA-volume composition; "comp" is the PRODUCTION fallback
+            # (gather warp + Pallas corr kernels) — the baseline the fused
+            # kernel must beat for the allowlist to admit it
             steps = {
                 "xla": jax.jit(functools.partial(warp_corr81, impl="xla")),
+                "comp": jax.jit(lambda a, b2, fl2: corr81(
+                    a, warp_backward(b2, fl2), "auto")),
                 "pallas": jax.jit(warp_corr81_pallas),
             }
-            for impl in ("xla", "pallas"):
+            for impl in ("xla", "comp", "pallas"):
                 name = f"L{level}_{side}x{side}c{c}_{dtype_name}_{impl}"
                 try:
                     sec = time_fn(name, steps[impl], mk, iters=8)

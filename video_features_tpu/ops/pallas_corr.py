@@ -372,35 +372,34 @@ def _warp_corr_supported(b: int, h: int, w: int, c: int, itemsize: int) -> bool:
     return 2 * (f2_bytes + flow_bytes + onehot_bytes + work_bytes) <= _VMEM_BUDGET
 
 
-def _fused_compile_ok(h: int, w: int, c: int, dtype) -> bool:
-    """Compile + win allowlist for the fused kernel on the axon v5e backend.
+def _fused_compile_ok(h: int, w: int, dtype) -> bool:
+    """Admission gate for the fused kernel under ``auto`` (axon v5e backend).
 
-    Two empirical limits (tools/warp_corr_profile.json, round 4):
+    Empirical findings (tools/warp_corr_profile.json, round 4):
 
     - COMPILE: the Mosaic remote compile helper crashes (HTTP 500, no
       diagnostics) or wedges for 30+ minutes past an undocumented complexity
       cliff — hw ≤ 256 (PWC levels 5/4 at a 256² input) compiles in seconds
-      in both dtypes; 32² fp32 compiled but bf16 WEDGED; 64² crashes.
-    - WIN: within the compiling set, the fused kernel only beat the
-      composition (gather warp + tiled-corr kernel) at L5 fp32 (+19 %) and
-      L4 bf16 (+28 %); it LOST L4 fp32 (−43 %) and L5 bf16 (−9 %) — so the
-      allowlist is dtype-aware, admitting only the measured winners.
+      in both dtypes and is bit-exact; 32² fp32 compiled but bf16 WEDGED;
+      64² crashes.
+    - WIN, so far unproven vs the RIGHT baseline: per-level the fused kernel
+      beat the gather-warp + fused-XLA-volume composition at L5 fp32 (+19 %)
+      and L4 bf16 (+28 %) — but production ``auto`` falls back to the
+      gather-warp + PALLAS-corr composition (round-3's measured winner),
+      which those numbers do not compare against.
 
-    Like the tiled-corr 16² tile cap the set is empirical and re-measured by
-    ``tools/profile_warp_corr.py`` (which bypasses this gate to reach the
-    kernel). ``VFT_FUSED_WARP_CORR`` forces: "0" disables the fused kernel,
-    "1" bypasses the allowlist (compile hazard: see above).
+    Until the whole-forward sweep (``profile_warp_corr.py --forward``: auto
+    vs auto_nofused) demonstrates a win over the real fallback, ``auto``
+    keeps the fused kernel DISABLED; ``VFT_FUSED_WARP_CORR=1`` enables it
+    within the compiling set (hw ≤ 256 — the compile hazard above is real),
+    "0" disables even under a future default-on.
     """
     import os
 
     force = os.environ.get("VFT_FUSED_WARP_CORR")
-    if force == "0":
-        return False
     if force == "1":
-        return True
-    if dtype == jnp.bfloat16:
-        return 64 < h * w <= 256
-    return h * w <= 64
+        return h * w <= 256
+    return False
 
 
 def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
@@ -421,7 +420,7 @@ def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
     if impl in ("pallas", "auto") and jax.default_backend() == "tpu" \
             and f1.dtype in _KERNEL_DTYPES:
         b, h, w, c = f1.shape
-        if _fused_compile_ok(h, w, c, f1.dtype) and \
+        if _fused_compile_ok(h, w, f1.dtype) and \
                 _warp_corr_supported(b, h, w, c, jnp.dtype(f1.dtype).itemsize):
             return warp_corr81_pallas(f1, f2, flow)
     return corr81(f1, warp_backward(f2, flow), impl)
