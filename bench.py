@@ -251,6 +251,24 @@ def main() -> None:
             json.dump(details, f, indent=2)
         os.replace(path + ".tmp", path)
 
+    import contextlib
+
+    @contextlib.contextmanager
+    def guarded(name):
+        """Per-config fault barrier: a compile failure (e.g. a Mosaic helper
+        crash on one shape) records the failure and the sweep continues — one
+        bad config must not sink the remaining record. Failures land under
+        ``run_failures`` so a transient error cannot clobber a committed good
+        entry for the same config (the merge-update contract); the headline
+        fp32 config is deliberately NOT guarded — with no headline there is
+        no record, and the driver must see the nonzero exit."""
+        try:
+            yield
+        except Exception as e:  # noqa: BLE001
+            details.setdefault("run_failures", {})[name] = str(e)[:300]
+            flush_details()
+            _log(f"{name}: FAILED — {str(e)[:160]}")
+
     def record(name, timing, units_per_iter, unit, flops_per_iter, chips=None):
         secs_per_iter, sync, iters_run = timing
         tflops = flops_per_iter / secs_per_iter / 1e12 if flops_per_iter else None
@@ -315,24 +333,29 @@ def main() -> None:
     for dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
         if dtype != "float32" and over_budget(f"i3d_rgb_{dtype}"):
             continue
-        ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
-                            step_size=stack, clips_per_batch=clips, dtype=dtype))
-        _log(f"i3d_rgb_{dtype}: built extractor "
-             f"({ex.clips_per_batch} clips × {stack + 1} frames × 256², mesh-rounded)")
+        # the fp32 HEADLINE config is unguarded on purpose: if it fails there
+        # is no summary line and the driver must see the nonzero exit
+        barrier = (contextlib.nullcontext() if dtype == "float32"
+                   else guarded(f"i3d_rgb_{dtype}"))
+        with barrier:
+            ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
+                                step_size=stack, clips_per_batch=clips, dtype=dtype))
+            _log(f"i3d_rgb_{dtype}: built extractor "
+                 f"({ex.clips_per_batch} clips × {stack + 1} frames × 256², mesh-rounded)")
 
-        def mk(ex=ex):
-            return (ex.i3d_params["rgb"],
-                    ex.runner.put(rng.integers(0, 256,
-                                               (ex.clips_per_batch, stack + 1, 256, 256, 3),
-                                               dtype=np.uint8)))
+            def mk(ex=ex):
+                return (ex.i3d_params["rgb"],
+                        ex.runner.put(rng.integers(0, 256,
+                                                   (ex.clips_per_batch, stack + 1, 256, 256, 3),
+                                                   dtype=np.uint8)))
 
-        _log(f"i3d_rgb_{dtype}: compiling + timing")
-        timing = _time_step(ex._rgb_step, mk, iters, _repeats(on_cpu))
-        e = record(f"i3d_rgb_{dtype}", timing, ex.clips_per_batch * stack / 64.0,
-                   "clips/sec/chip", _flops_of(ex._rgb_step, *mk()))
-        if dtype == "float32":
-            headline = e
-            print_summary()  # headline secured — a later kill loses nothing
+            _log(f"i3d_rgb_{dtype}: compiling + timing")
+            timing = _time_step(ex._rgb_step, mk, iters, _repeats(on_cpu))
+            e = record(f"i3d_rgb_{dtype}", timing, ex.clips_per_batch * stack / 64.0,
+                       "clips/sec/chip", _flops_of(ex._rgb_step, *mk()))
+            if dtype == "float32":
+                headline = e
+                print_summary()  # headline secured — a later kill loses nothing
 
     # fp32 stem through the TapConv3D lowering (VFT_I3D_TAP_FP32 — joint-
     # extent convs only; reassociates the temporal sum, hence not the
@@ -340,20 +363,21 @@ def main() -> None:
     if not on_cpu and not over_budget("i3d_rgb_float32_tapconv"):
         os.environ["VFT_I3D_TAP_FP32"] = "1"
         try:
-            ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
-                                step_size=stack, clips_per_batch=clips,
-                                dtype="float32"))
+            with guarded("i3d_rgb_float32_tapconv"):
+                ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
+                                    step_size=stack, clips_per_batch=clips,
+                                    dtype="float32"))
 
-            def mk_tap(ex=ex):
-                return (ex.i3d_params["rgb"],
-                        ex.runner.put(rng.integers(
-                            0, 256, (ex.clips_per_batch, stack + 1, 256, 256, 3),
-                            dtype=np.uint8)))
+                def mk_tap(ex=ex):
+                    return (ex.i3d_params["rgb"],
+                            ex.runner.put(rng.integers(
+                                0, 256, (ex.clips_per_batch, stack + 1, 256, 256, 3),
+                                dtype=np.uint8)))
 
-            timing = _time_step(ex._rgb_step, mk_tap, iters, _repeats(on_cpu))
-            record("i3d_rgb_float32_tapconv", timing,
-                   ex.clips_per_batch * stack / 64.0, "clips/sec/chip",
-                   _flops_of(ex._rgb_step, *mk_tap()))
+                timing = _time_step(ex._rgb_step, mk_tap, iters, _repeats(on_cpu))
+                record("i3d_rgb_float32_tapconv", timing,
+                       ex.clips_per_batch * stack / 64.0, "clips/sec/chip",
+                       _flops_of(ex._rgb_step, *mk_tap()))
         finally:
             del os.environ["VFT_I3D_TAP_FP32"]
 
@@ -365,21 +389,40 @@ def main() -> None:
             for flow_dtype in ("float32", "bfloat16"):
                 if over_budget(f"i3d_flow_{flow_type}_{flow_dtype}"):
                     continue
-                _log(f"i3d_flow_{flow_type}_{flow_dtype}: building extractor + inputs")
-                ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type=flow_type,
-                                    stack_size=64, step_size=64, clips_per_batch=1,
-                                    flow_dtype=flow_dtype))
+                with guarded(f"i3d_flow_{flow_type}_{flow_dtype}"):
+                    _log(f"i3d_flow_{flow_type}_{flow_dtype}: building extractor + inputs")
+                    ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type=flow_type,
+                                        stack_size=64, step_size=64, clips_per_batch=1,
+                                        flow_dtype=flow_dtype))
 
-                def mk_flow(ex=ex):
+                    def mk_flow(ex=ex):
+                        return (ex.i3d_params["flow"],
+                                ex.runner.put(rng.integers(
+                                    0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
+                                    dtype=np.uint8)))
+
+                    timing = _time_step(ex._flow_step, mk_flow, iters=2)
+                    record(f"i3d_flow_{flow_type}_{flow_dtype}", timing,
+                           ex.clips_per_batch, "clips/sec/chip",
+                           _flops_of(ex._flow_step, *mk_flow()))
+
+        # performance-max two-stream flow step: BOTH the flow net and the I3D
+        # conv stack in bf16 (the configs above keep the I3D side fp32)
+        if not over_budget("i3d_flow_pwc_allbf16"):
+            with guarded("i3d_flow_pwc_allbf16"):
+                ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type="pwc",
+                                    stack_size=64, step_size=64, clips_per_batch=1,
+                                    dtype="bfloat16", flow_dtype="bfloat16"))
+
+                def mk_flow_ab(ex=ex):
                     return (ex.i3d_params["flow"],
                             ex.runner.put(rng.integers(
                                 0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
                                 dtype=np.uint8)))
 
-                timing = _time_step(ex._flow_step, mk_flow, iters=2)
-                record(f"i3d_flow_{flow_type}_{flow_dtype}", timing,
-                       ex.clips_per_batch, "clips/sec/chip",
-                       _flops_of(ex._flow_step, *mk_flow()))
+                timing = _time_step(ex._flow_step, mk_flow_ab, iters=2)
+                record("i3d_flow_pwc_allbf16", timing, ex.clips_per_batch,
+                       "clips/sec/chip", _flops_of(ex._flow_step, *mk_flow_ab()))
 
     # ---- RAFT dense flow: pairs/sec at 256² (20 GRU iterations) ---------------
     # production single-chip path: the shared-frame step (each frame encoded
@@ -388,24 +431,26 @@ def main() -> None:
     for flow_dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
         if over_budget(f"raft_pairs_{flow_dtype}"):
             continue
-        _log(f"raft_pairs_{flow_dtype}: building extractor + inputs "
-             f"({pairs} pairs × {side}²)")
-        ex = ExtractFlow(cfg("raft", batch_size=pairs, num_devices=1,
-                             flow_dtype=flow_dtype))
+        with guarded(f"raft_pairs_{flow_dtype}"):
+            _log(f"raft_pairs_{flow_dtype}: building extractor + inputs "
+                 f"({pairs} pairs × {side}²)")
+            ex = ExtractFlow(cfg("raft", batch_size=pairs, num_devices=1,
+                                 flow_dtype=flow_dtype))
 
-        def mk_pairs(ex=ex):
-            fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
-            return (ex.params, ex.runner.put(fr))
+            def mk_pairs(ex=ex):
+                fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
+                return (ex.params, ex.runner.put(fr))
 
-        timing = _time_step(ex._frames_step, mk_pairs, iters=1 if on_cpu else 6,
-                            repeats=_repeats(on_cpu))
-        record(f"raft_pairs_{flow_dtype}", timing, ex.batch_size, "pairs/sec/chip",
-               _flops_of(ex._frames_step, *mk_pairs()), chips=ex.runner.num_devices)
+            timing = _time_step(ex._frames_step, mk_pairs, iters=1 if on_cpu else 6,
+                                repeats=_repeats(on_cpu))
+            record(f"raft_pairs_{flow_dtype}", timing, ex.batch_size, "pairs/sec/chip",
+                   _flops_of(ex._frames_step, *mk_pairs()), chips=ex.runner.num_devices)
 
     # ---- PWC dense flow: pairs/sec at 256², xla vs auto cost volume -----------
-    # auto = the production default: tiled Pallas volume kernels + the fused
-    # warp+corr kernel where the calibrated gates admit the shape, fused-XLA
-    # elsewhere (ops/pallas_corr). The b2 pair preserves round-3 continuity.
+    # auto = the production default: tiled/single-block Pallas volume kernels
+    # where the VMEM gates admit the shape, fused-XLA elsewhere (the fused
+    # warp+corr kernel stays opt-in — ops/pallas_corr._fused_compile_ok).
+    # The b2 pair preserves round-3 continuity.
     pwc_configs = [("xla", pairs, "float32")]
     if not on_cpu:
         pwc_configs += [("auto", pairs, "float32"),
@@ -414,20 +459,21 @@ def main() -> None:
     for corr, b, flow_dtype in pwc_configs:
         if over_budget(f"pwc_pairs_{flow_dtype}_{corr}_b{b}"):
             continue
-        _log(f"pwc_pairs_{flow_dtype}_{corr}_b{b}: building extractor + inputs "
-             f"({b} pairs × {side}²)")
-        ex = ExtractFlow(cfg("pwc", batch_size=b, pwc_corr=corr, num_devices=1,
-                             flow_dtype=flow_dtype))
+        with guarded(f"pwc_pairs_{flow_dtype}_{corr}_b{b}"):
+            _log(f"pwc_pairs_{flow_dtype}_{corr}_b{b}: building extractor + inputs "
+                 f"({b} pairs × {side}²)")
+            ex = ExtractFlow(cfg("pwc", batch_size=b, pwc_corr=corr, num_devices=1,
+                                 flow_dtype=flow_dtype))
 
-        def mk_pwc(ex=ex):
-            fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
-            return (ex.params, ex.runner.put(fr))
+            def mk_pwc(ex=ex):
+                fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)).astype(np.float32)
+                return (ex.params, ex.runner.put(fr))
 
-        timing = _time_step(ex._frames_step, mk_pwc, iters=1 if on_cpu else 6,
-                            repeats=_repeats(on_cpu))
-        record(f"pwc_pairs_{flow_dtype}_{corr}_b{b}", timing, ex.batch_size,
-               "pairs/sec/chip", _flops_of(ex._frames_step, *mk_pwc()),
-               chips=ex.runner.num_devices)
+            timing = _time_step(ex._frames_step, mk_pwc, iters=1 if on_cpu else 6,
+                                repeats=_repeats(on_cpu))
+            record(f"pwc_pairs_{flow_dtype}_{corr}_b{b}", timing, ex.batch_size,
+                   "pairs/sec/chip", _flops_of(ex._frames_step, *mk_pwc()),
+                   chips=ex.runner.num_devices)
 
     # ---- R(2+1)D: clips/sec, 16-frame 112² slices (reference r21d geometry) ---
     if not on_cpu:
@@ -436,52 +482,55 @@ def main() -> None:
         for dtype in ("float32", "bfloat16"):
             if over_budget(f"r21d_{dtype}"):
                 continue
-            _log(f"r21d_{dtype}: building extractor + inputs")
-            ex = ExtractR21D(cfg("r21d_rgb", clips_per_batch=8, dtype=dtype))
+            with guarded(f"r21d_{dtype}"):
+                _log(f"r21d_{dtype}: building extractor + inputs")
+                ex = ExtractR21D(cfg("r21d_rgb", clips_per_batch=8, dtype=dtype))
 
-            def mk_r21d(ex=ex):
-                return (ex.params,
-                        ex.runner.put(rng.integers(
-                            0, 256, (ex.clips_per_batch, 16, 128, 171, 3),
-                            dtype=np.uint8)))
+                def mk_r21d(ex=ex):
+                    return (ex.params,
+                            ex.runner.put(rng.integers(
+                                0, 256, (ex.clips_per_batch, 16, 128, 171, 3),
+                                dtype=np.uint8)))
 
-            timing = _time_step(ex._step, mk_r21d, iters=8, repeats=_repeats(on_cpu))
-            record(f"r21d_{dtype}", timing, ex.clips_per_batch, "clips/sec/chip",
-                   _flops_of(ex._step, *mk_r21d()))
+                timing = _time_step(ex._step, mk_r21d, iters=8, repeats=_repeats(on_cpu))
+                record(f"r21d_{dtype}", timing, ex.clips_per_batch, "clips/sec/chip",
+                       _flops_of(ex._step, *mk_r21d()))
 
     # ---- VGGish: 0.96s examples/sec --------------------------------------------
     if not on_cpu and not over_budget("vggish_float32"):
-        from video_features_tpu.extractors.vggish import ExtractVGGish
+        with guarded("vggish_float32"):
+            from video_features_tpu.extractors.vggish import ExtractVGGish
 
-        _log("vggish: building extractor + inputs")
-        ex = ExtractVGGish(cfg("vggish"))
+            _log("vggish: building extractor + inputs")
+            ex = ExtractVGGish(cfg("vggish"))
 
-        def mk_vggish(ex=ex):
-            return (ex.params,
-                    ex.runner.put(rng.standard_normal(
-                        (ex.example_batch, 96, 64)).astype(np.float32)))
+            def mk_vggish(ex=ex):
+                return (ex.params,
+                        ex.runner.put(rng.standard_normal(
+                            (ex.example_batch, 96, 64)).astype(np.float32)))
 
-        timing = _time_step(ex._step, mk_vggish, iters=8, repeats=_repeats(on_cpu))
-        record("vggish_float32", timing, ex.example_batch, "examples/sec/chip",
-               _flops_of(ex._step, *mk_vggish()))
+            timing = _time_step(ex._step, mk_vggish, iters=8, repeats=_repeats(on_cpu))
+            record("vggish_float32", timing, ex.example_batch, "examples/sec/chip",
+                   _flops_of(ex._step, *mk_vggish()))
 
     # ---- ResNet-50 frames/sec (round-1 metric, kept for continuity) -----------
     batch = 4 if on_cpu else 64
     for dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
         if over_budget(f"resnet50_{dtype}"):
             continue
-        _log(f"resnet50_{dtype}: building extractor + inputs")
-        ex = ExtractResNet50(cfg("resnet50", batch_size=batch, dtype=dtype))
+        with guarded(f"resnet50_{dtype}"):
+            _log(f"resnet50_{dtype}: building extractor + inputs")
+            ex = ExtractResNet50(cfg("resnet50", batch_size=batch, dtype=dtype))
 
-        def mk_frames(ex=ex):
-            return (ex.params,
-                    ex.runner.put(rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
-                                               dtype=np.uint8)))
+            def mk_frames(ex=ex):
+                return (ex.params,
+                        ex.runner.put(rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
+                                                   dtype=np.uint8)))
 
-        timing = _time_step(ex._step, mk_frames, iters=2 if on_cpu else 16,
-                            repeats=_repeats(on_cpu))
-        record(f"resnet50_{dtype}", timing, ex.batch_size, "frames/sec/chip",
-               _flops_of(ex._step, *mk_frames()))
+            timing = _time_step(ex._step, mk_frames, iters=2 if on_cpu else 16,
+                                repeats=_repeats(on_cpu))
+            record(f"resnet50_{dtype}", timing, ex.batch_size, "frames/sec/chip",
+                   _flops_of(ex._step, *mk_frames()))
 
     # ---- end-to-end extract(): decode → transform → device → collect ----------
     # The reference's real workload is whole videos through the full pipeline
@@ -556,31 +605,33 @@ def main() -> None:
             for workers in (1, 4):
                 if over_budget(f"e2e_resnet50_float32_w{workers}"):
                     continue
-                ex = ExtractResNet50(cfg("resnet50", batch_size=64,
-                                         decode_workers=workers))
-                bench_e2e(
-                    f"e2e_resnet50_float32_w{workers}", ex,
-                    lambda ex=ex: _force(ex._step(ex.params, ex.runner.put(
-                        rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
-                                     dtype=np.uint8)))),
-                    "resnet50", "frames")
+                with guarded(f"e2e_resnet50_float32_w{workers}"):
+                    ex = ExtractResNet50(cfg("resnet50", batch_size=64,
+                                             decode_workers=workers))
+                    bench_e2e(
+                        f"e2e_resnet50_float32_w{workers}", ex,
+                        lambda ex=ex: _force(ex._step(ex.params, ex.runner.put(
+                            rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
+                                         dtype=np.uint8)))),
+                        "resnet50", "frames")
 
             # flagship two-stream I3D at the reference default (flow via PWC);
             # sample videos decode to 256×341 after the 256-edge resize
             if not over_budget("e2e_i3d_two_stream_pwc_float32_w1"):
-                ex = ExtractI3D(cfg("i3d", streams=("rgb", "flow"),
-                                    flow_type="pwc", stack_size=64,
-                                    step_size=64, clips_per_batch=1))
+                with guarded("e2e_i3d_two_stream_pwc_float32_w1"):
+                    ex = ExtractI3D(cfg("i3d", streams=("rgb", "flow"),
+                                        flow_type="pwc", stack_size=64,
+                                        step_size=64, clips_per_batch=1))
 
-                def warm_i3d(ex=ex):
-                    stacks = ex.runner.put(rng.integers(
-                        0, 256, (ex.clips_per_batch, 65, 256, 341, 3),
-                        dtype=np.uint8))
-                    _force(ex._rgb_step(ex.i3d_params["rgb"], stacks))
-                    _force(ex._flow_step(ex.i3d_params["flow"], stacks))
+                    def warm_i3d(ex=ex):
+                        stacks = ex.runner.put(rng.integers(
+                            0, 256, (ex.clips_per_batch, 65, 256, 341, 3),
+                            dtype=np.uint8))
+                        _force(ex._rgb_step(ex.i3d_params["rgb"], stacks))
+                        _force(ex._flow_step(ex.i3d_params["flow"], stacks))
 
-                bench_e2e("e2e_i3d_two_stream_pwc_float32_w1", ex, warm_i3d,
-                          "rgb", "stacks")
+                    bench_e2e("e2e_i3d_two_stream_pwc_float32_w1", ex, warm_i3d,
+                              "rgb", "stacks")
 
             def warm_raft(ex):
                 # both sample geometries: v1 decodes 240x320, v2 360x480 — a
@@ -598,10 +649,12 @@ def main() -> None:
                 name = f"e2e_raft_float32_w{workers}{tag}"
                 if over_budget(name):
                     continue
-                ex = ExtractFlow(cfg("raft", batch_size=16, num_devices=1,
-                                     decode_workers=workers,
-                                     transfer_dtype=tdt))
-                bench_e2e(name, ex, lambda ex=ex: warm_raft(ex), "raft", "pairs")
+                with guarded(name):
+                    ex = ExtractFlow(cfg("raft", batch_size=16, num_devices=1,
+                                         decode_workers=workers,
+                                         transfer_dtype=tdt))
+                    bench_e2e(name, ex, lambda ex=ex: warm_raft(ex),
+                              "raft", "pairs")
 
     # ---- headline line (re-print; first printed right after i3d_rgb) ----------
     if skipped:
