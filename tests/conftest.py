@@ -51,3 +51,32 @@ def sample_video_2():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# ---- test tiers -----------------------------------------------------------
+# fast: the pure-math/unit layer — `pytest -m fast` gives pre-commit signal in
+# under a minute on a 1-core host (round-4 review: the full non-slow tier no
+# longer fits a quick review budget). Membership is by module (measured
+# per-module wall times, /tmp-tier sweep round 5); new quick modules should be
+# added here. `slow` stays the parity/e2e layer; everything else is the
+# default `not slow` tier.
+_FAST_MODULES = {
+    "test_config_cli",
+    "test_edge_cases",
+    "test_filelist_output",
+    "test_fps_resampler",
+    "test_golden_pipeline",
+    "test_mirror_independence",
+    "test_parallel",
+    "test_resample",
+    "test_resnet_extractor",
+    "test_spatial",
+    "test_video_decode",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (item.module.__name__ in _FAST_MODULES
+                and "slow" not in item.keywords):
+            item.add_marker(pytest.mark.fast)

@@ -174,3 +174,69 @@ def test_r21d_bf16_close_to_fp32():
     fbf = np.asarray(mbf.apply({"params": p}, x, features=True))
     scale = np.abs(f32).max() + 1e-6
     assert np.abs(f32 - fbf).max() <= 0.05 * scale
+
+
+def test_warp_onehot_matches_gather():
+    """MXU one-hot selector warp == gather warp (ops/warp.bilinear_sample_onehot):
+    same zero-padding semantics (OOB taps fall off the iota), ≤ 1-ulp fp
+    association differences, incl. far-OOB flows and edge-exact coords."""
+    from video_features_tpu.ops.warp import (bilinear_sample, bilinear_sample_onehot, warp_backward)
+
+    rng = np.random.default_rng(3)
+    img = rng.standard_normal((2, 11, 15, 6)).astype(np.float32)
+    flow = (rng.uniform(-12, 12, (2, 11, 15, 2))).astype(np.float32)
+    ref = np.asarray(warp_backward(jnp.asarray(img), jnp.asarray(flow), impl="gather"))
+    out = np.asarray(warp_backward(jnp.asarray(img), jnp.asarray(flow), impl="onehot"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # raw sampler: edge-exact + OOB coords, and the chunked path (chunk < P)
+    coords = rng.uniform(-4, 18, (2, 5, 7, 2)).astype(np.float32)
+    coords[0, 0, 0] = [0.0, 0.0]
+    coords[0, 0, 1] = [14.0, 10.0]   # exact far corner
+    coords[0, 0, 2] = [-1.0, -1.0]   # fully OOB → 0
+    a = np.asarray(bilinear_sample(jnp.asarray(img), jnp.asarray(coords)))
+    b = np.asarray(bilinear_sample_onehot(jnp.asarray(img), jnp.asarray(coords),
+                                          chunk_budget=15 * 6 * 3))
+    np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+
+def test_warp_onehot_bf16_within_budget():
+    """bf16 one-hot warp error vs the fp32 gather path stays within ~2× the
+    bf16 VALUE-rounding floor (selector-weight rounding adds ~0.4%·|v|);
+    the keep-mask is fp32 closed-form, so no spurious border zeroing."""
+    from video_features_tpu.ops.warp import warp_backward
+
+    rng = np.random.default_rng(4)
+    img = rng.standard_normal((2, 16, 16, 8)).astype(np.float32)
+    flow = rng.uniform(-5, 5, (2, 16, 16, 2)).astype(np.float32)
+    ref = np.asarray(warp_backward(jnp.asarray(img), jnp.asarray(flow), impl="gather"))
+    out = np.asarray(warp_backward(jnp.asarray(img).astype(jnp.bfloat16),
+                                   jnp.asarray(flow), impl="onehot"))
+    # identical zero-set (mask parity) and bounded value drift
+    np.testing.assert_array_equal(out == 0, np.abs(ref) < 1e-7)
+    np.testing.assert_allclose(out, ref, rtol=0.02, atol=0.02)
+
+
+def test_raft_on_demand_matmul_matches_gather():
+    """The gather-free on-demand lookup (per-iteration MXU volume remat +
+    one-hot window selection, models/raft._lookup_on_demand impl='matmul')
+    must match the gather formulation, incl. OOB windows and the chunked
+    query path (chunk < H·W)."""
+    from video_features_tpu.models.raft import (
+        _build_f2_pyramid, _lookup_on_demand, coords_grid)
+
+    rng = np.random.default_rng(5)
+    b, h, w, d = 2, 16, 24, 12
+    f1 = jnp.asarray(rng.standard_normal((b, h, w, d)).astype(np.float32))
+    f2 = jnp.asarray(rng.standard_normal((b, h, w, d)).astype(np.float32))
+    pyr = _build_f2_pyramid(f2)
+    # coords: grid + big random flow so plenty of windows leave the image
+    coords = coords_grid(b, h, w) + jnp.asarray(
+        rng.uniform(-10, 10, (b, h, w, 2)).astype(np.float32))
+    ref = np.asarray(_lookup_on_demand(f1, pyr, coords, "gather"))
+    out = np.asarray(_lookup_on_demand(f1, pyr, coords, "matmul"))
+    assert out.shape == ref.shape == (b, h, w, 4 * 81)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+    # forced tiny chunks exercise the scan + tail-pad path
+    out_c = np.asarray(_lookup_on_demand(f1, pyr, coords, "matmul",
+                                         chunk_budget=h * w * 7))
+    np.testing.assert_allclose(out_c, ref, rtol=1e-4, atol=1e-4)

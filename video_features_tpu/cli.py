@@ -66,13 +66,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "fp32 either way (float32 = reference parity; "
                              "measured bf16 drift in tests/test_flow_bf16.py)")
     parser.add_argument("--raft_corr",
-                        choices=["auto", "volume", "volume_gather", "on_demand"],
+                        choices=["auto", "volume", "volume_gather", "on_demand",
+                                 "on_demand_matmul"],
                         default="auto",
                         help="RAFT correlation: auto (default) = materialized "
                              "pyramid with MXU matmul lookup unless the volume "
                              "would outgrow HBM for the frame size, then the "
                              "on-demand alt_cuda_corr equivalent (O(H*W) memory); "
-                             "or force volume / volume_gather / on_demand")
+                             "or force volume / volume_gather / on_demand / "
+                             "on_demand_matmul (gather-free on-demand: remat "
+                             "the volume slice per iteration on the MXU)")
     parser.add_argument("--pwc_corr", choices=["auto", "xla", "pallas"],
                         default="auto",
                         help="PWC cost-volume implementation: auto picks the "

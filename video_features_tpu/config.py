@@ -149,8 +149,10 @@ class ExtractionConfig:
             raise ValueError("clips_per_batch must be >= 1")
         if self.flow_dtype not in ("float32", "bfloat16"):
             raise ValueError("flow_dtype must be float32|bfloat16")
-        if self.raft_corr not in ("auto", "volume", "volume_gather", "on_demand"):
-            raise ValueError("raft_corr must be auto|volume|volume_gather|on_demand")
+        if self.raft_corr not in ("auto", "volume", "volume_gather", "on_demand",
+                                  "on_demand_matmul"):
+            raise ValueError(
+                "raft_corr must be auto|volume|volume_gather|on_demand|on_demand_matmul")
         if self.pwc_corr not in ("auto", "xla", "pallas"):
             raise ValueError("pwc_corr must be auto|xla|pallas")
         if self.matmul_precision not in (None, "default", "high", "highest"):
@@ -167,8 +169,11 @@ class ExtractionConfig:
             raise ValueError("shape_bucket must be a multiple of 8 (RAFT /8 contract)")
         if self.transfer_dtype not in ("float32", "float16", "bfloat16"):
             raise ValueError("transfer_dtype must be float32|float16|bfloat16")
-        if self.i3d_crop_size < 32:
-            raise ValueError("i3d_crop_size must be >= 32 (five /2 stages)")
+        if self.i3d_crop_size < 32 or self.i3d_crop_size % 32:
+            # five stride-2 stages: a non-multiple-of-32 crop produces odd
+            # intermediate dims (implementation-defined pooling geometry)
+            raise ValueError("i3d_crop_size must be a multiple of 32 "
+                             "(five /2 stages)")
         if self.i3d_pre_crop_size < self.i3d_crop_size:
             raise ValueError("i3d_pre_crop_size must be >= i3d_crop_size")
 

@@ -57,6 +57,7 @@ class ExtractFlow(Extractor):
         # sharded pair axis divides evenly (tail pairs repeat the last frame)
         self.batch_size = self.runner.device_batch(cfg.batch_size)
         self._viz_counter = 0  # --show_pred PNG fallback numbering
+        self._async_copy_ok = True  # cleared on first missing-API probe
         flow_dtype = jnp.bfloat16 if cfg.flow_dtype == "bfloat16" else jnp.float32
         # D2H transfer dtype: the jitted steps cast their output to this on
         # device; the host upcasts back to fp32. float16 halves the fetched
@@ -158,10 +159,16 @@ class ExtractFlow(Extractor):
             prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
             nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
             flow = self._step(self.params, prev, nxt)
-        try:
-            flow.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — backends without async host copy
-            pass
+        if self._async_copy_ok:
+            try:
+                flow.copy_to_host_async()
+            except (AttributeError, NotImplementedError):
+                # backend lacks async host copy — probe once, note it, and
+                # stop trying (a blanket pass here once swallowed genuine
+                # transfer errors whose context only resurfaced at _wait)
+                self._async_copy_ok = False
+                print("[flow] backend has no copy_to_host_async; D2H "
+                      "transfers will not overlap compute", flush=True)
         return flow, n_pairs, pads
 
     def _collect_pairs(self, handle) -> np.ndarray:

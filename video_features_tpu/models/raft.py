@@ -265,37 +265,94 @@ def _build_f2_pyramid(f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
     return tuple(pyr)
 
 
-def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray) -> jnp.ndarray:
+def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
+                      impl: str = "gather",
+                      chunk_budget: int = 16_000_000) -> jnp.ndarray:
     """Correlation window computed on the fly from pooled-f2 features — the
-    memory-bounded path (O(H·W·D), no (H·W)² volume).
+    memory-bounded path (O(H·W·D), no persistent (H·W)² volume).
 
-    Bilinear interpolation commutes with the channel dot product, so instead of
-    sampling f2 at 81 fractional points (324 corner gathers of D-vectors per
-    query), gather ONE 10×10 integer patch of f2 vectors per query per level,
-    contract with f1 on the MXU, and form the 81 bilinear values as four
-    shifted combinations of the (10, 10) correlation patch — ~3× fewer
-    gathered bytes and one gather per level. Numerics identical to the
-    fractional-point formulation up to fp reduction order (the bilinear
-    weights multiply the same products)."""
+    ``impl='gather'``: bilinear interpolation commutes with the channel dot
+    product, so instead of sampling f2 at 81 fractional points (324 corner
+    gathers of D-vectors per query), gather ONE 10×10 integer patch of f2
+    vectors per query per level, contract with f1 on the MXU, and form the 81
+    bilinear values as four shifted combinations of the (10, 10) correlation
+    patch — ~3× fewer gathered bytes and one gather per level. Numerics
+    identical to the fractional-point formulation up to fp reduction order
+    (the bilinear weights multiply the same products).
+
+    ``impl='matmul'``: zero gathers — rematerialize the chunk's slice of the
+    correlation volume each call (``einsum('bnc,bijc->bnij')``, pure MXU) and
+    select the 10×10 window with the volume path's one-hot matmuls
+    (models/raft.py one-hot trick, 15.5× there). The volume slice does not
+    persist: O(chunk·hᵢ·wᵢ) live bytes, bounded by ``chunk_budget`` elements
+    per batch element via ``lax.scan`` over query chunks. Against the gather
+    impl this trades ITERS× recomputed volume FLOPs (MXU-cheap) for zero
+    scalar-unit gather traffic (the measured 40× cliff); against ``volume``
+    it trades the same FLOPs for the O((H·W)²) HBM the big-frame regime
+    doesn't have. Reference anchor: ``alt_cuda_corr``
+    (/root/reference/models/raft/corr.py:63-91) recomputes per-iteration too.
+    """
+    if impl not in ("gather", "matmul"):
+        raise ValueError(
+            f"on-demand lookup impl must be gather|matmul, got {impl!r}")
     b, h, w, d = f1.shape
     r = CORR_RADIUS
     win = 2 * r + 2  # 10 taps per axis
     scale = 1.0 / math.sqrt(d)
     f1 = f1.astype(jnp.float32)
+    n = h * w
     out = []
     for i, f2i in enumerate(f2_pyramid):
         hi, wi = f2i.shape[1], f2i.shape[2]
         if hi == 0 or wi == 0:
+            # tiny inputs can pool a pyramid level away entirely; every tap is
+            # out of bounds → zeros (the per-corner mask semantics)
             out.append(jnp.zeros((b, h, w, (2 * r + 1) ** 2), jnp.float32))
             continue
-        ix, iy, fx, fy = _int_window((coords / 2**i).reshape(b, h * w, 2))
-        idx, mask = _tap_index_mask(ix, iy, hi, wi)  # (B, HW, 10y, 10x)
-        flat = f2i.reshape(b, hi * wi, -1).astype(jnp.float32)
-        patch_f = jnp.take_along_axis(
-            flat[:, None], idx.reshape(b, 1, h * w * win * win)[..., None], axis=2
-        ).reshape(b, h * w, win, win, -1)  # (B, HW, 10, 10, D) one gather/level
-        patch = jnp.einsum("bnc,bnpqc->bnpq", f1.reshape(b, h * w, d), patch_f) * scale
-        patch = patch * mask
+        ix, iy, fx, fy = _int_window((coords / 2**i).reshape(b, n, 2))
+        if impl == "matmul":
+            chunk = int(max(1, min(n, chunk_budget // (hi * wi))))
+            n_chunks = -(-n // chunk)
+            pad = n_chunks * chunk - n
+
+            def prep(a):  # (b, n, ...) → (n_chunks, b, chunk, ...)
+                a = jnp.pad(a, ((0, 0), (0, pad)) + ((0, 0),) * (a.ndim - 2))
+                return a.reshape((b, n_chunks, chunk) + a.shape[2:]).swapaxes(0, 1)
+
+            f2f = f2i.astype(jnp.float32)
+            iota_h = jnp.arange(hi, dtype=jnp.int32)
+            iota_w = jnp.arange(wi, dtype=jnp.int32)
+
+            def body(_, args):
+                f1c, ixc, iyc = args  # (b, chunk, d), (b, chunk, 10), ...
+                # DEFAULT precision: the same contraction precision the
+                # gather impl's f1·patch einsum runs at
+                vol = jnp.einsum("bnc,bijc->bnij", f1c, f2f)
+                sy = (iyc[..., None] == iota_h).astype(jnp.float32)
+                sx = (ixc[..., None] == iota_w).astype(jnp.float32)
+                # HIGHEST: one-hot selection must pass vol values through
+                # unrounded (one nonzero product per output); costs only
+                # 10/d of the vol einsum
+                rows = jnp.einsum("bnpi,bnij->bnpj", sy, vol,
+                                  precision=lax.Precision.HIGHEST)
+                patch = jnp.einsum("bnqj,bnpj->bnpq", sx, rows,
+                                   precision=lax.Precision.HIGHEST)
+                return None, patch * scale
+
+            _, patch = lax.scan(body, None,
+                                (prep(f1.reshape(b, n, d)), prep(ix), prep(iy)))
+            patch = patch.swapaxes(0, 1).reshape(b, n_chunks * chunk,
+                                                 win, win)[:, :n]
+            # OOB taps already zero (equality falls off the iota) — same
+            # semantics as the gather impl's explicit mask
+        else:
+            idx, mask = _tap_index_mask(ix, iy, hi, wi)  # (B, HW, 10y, 10x)
+            flat = f2i.reshape(b, hi * wi, -1).astype(jnp.float32)
+            patch_f = jnp.take_along_axis(
+                flat[:, None], idx.reshape(b, 1, n * win * win)[..., None], axis=2
+            ).reshape(b, n, win, win, -1)  # (B, HW, 10, 10, D) one gather/level
+            patch = jnp.einsum("bnc,bnpqc->bnpq", f1.reshape(b, n, d), patch_f) * scale
+            patch = patch * mask
         out.append(_combine_window(patch, fx, fy).reshape(b, h, w, -1))
     return jnp.concatenate(out, axis=-1)
 
@@ -376,9 +433,10 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     corr_impl = resolve_corr_impl(corr_impl, image1.shape[0],
                                   image1.shape[1], image1.shape[2], dtype,
                                   n_devices)
-    if corr_impl not in ("volume", "volume_gather", "on_demand"):
+    if corr_impl not in ("volume", "volume_gather", "on_demand", "on_demand_matmul"):
         raise ValueError(
-            f"corr_impl must be auto|volume|volume_gather|on_demand, got {corr_impl!r}")
+            f"corr_impl must be auto|volume|volume_gather|on_demand|"
+            f"on_demand_matmul, got {corr_impl!r}")
     x1 = (2.0 * (image1.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
     x2 = (2.0 * (image2.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
 
@@ -410,9 +468,10 @@ def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
     h, w = frames.shape[-3:-1]
     corr_impl = resolve_corr_impl(corr_impl, n * (nf - 1), h, w, dtype,
                                   n_devices)
-    if corr_impl not in ("volume", "volume_gather", "on_demand"):
+    if corr_impl not in ("volume", "volume_gather", "on_demand", "on_demand_matmul"):
         raise ValueError(
-            f"corr_impl must be auto|volume|volume_gather|on_demand, got {corr_impl!r}")
+            f"corr_impl must be auto|volume|volume_gather|on_demand|"
+            f"on_demand_matmul, got {corr_impl!r}")
     x = (2.0 * (frames.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
     x = x.reshape((n * nf, h, w, 3))
     feat = _encoder(params["fnet"], x, "instance").astype(jnp.float32)
@@ -445,7 +504,9 @@ def _refine_flow(params: Dict, f1: jnp.ndarray, f2: jnp.ndarray, cnet: jnp.ndarr
         lookup = lambda coords: _lookup(pyramid, coords, impl)  # noqa: E731
     else:
         f2_pyramid = _build_f2_pyramid(f2)
-        lookup = lambda coords: _lookup_on_demand(f1, f2_pyramid, coords)  # noqa: E731
+        od_impl = "matmul" if corr_impl == "on_demand_matmul" else "gather"
+        lookup = lambda coords: _lookup_on_demand(  # noqa: E731
+            f1, f2_pyramid, coords, od_impl)
 
     net = jnp.tanh(cnet[..., :HIDDEN_DIM]).astype(dtype)
     inp = _relu(cnet[..., HIDDEN_DIM:]).astype(dtype)

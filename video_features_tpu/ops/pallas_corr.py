@@ -367,9 +367,14 @@ def _warp_corr_supported(b: int, h: int, w: int, c: int, itemsize: int) -> bool:
     f2_bytes = h * w * c * itemsize
     flow_bytes = (hp + 2 * r) * (wp + 2 * r) * 2 * 4
     onehot_bytes = _halo_chunk_rows(h * w) * halo * h * w * 4
+    # the int32 iota3 comparand materialized alongside the one-hot chunk is
+    # the same (rows, halo, h*w) extent at 4 bytes — count it, or a
+    # near-budget shape passes the gate and fails VMEM assignment
+    iota_bytes = onehot_bytes
     work_bytes = (halo * halo * c * 4  # warped tile
                   + _TILE * _TILE * (c + CORR_CHANNELS) * itemsize)
-    return 2 * (f2_bytes + flow_bytes + onehot_bytes + work_bytes) <= _VMEM_BUDGET
+    return 2 * (f2_bytes + flow_bytes + onehot_bytes + iota_bytes
+                + work_bytes) <= _VMEM_BUDGET
 
 
 def _fused_compile_ok(h: int, w: int, dtype) -> bool:
@@ -391,14 +396,17 @@ def _fused_compile_ok(h: int, w: int, dtype) -> bool:
     Until the whole-forward sweep (``profile_warp_corr.py --forward``: auto
     vs auto_nofused) demonstrates a win over the real fallback, ``auto``
     keeps the fused kernel DISABLED; ``VFT_FUSED_WARP_CORR=1`` enables it
-    within the compiling set (hw ≤ 256 — the compile hazard above is real),
-    "0" disables even under a future default-on.
+    within the compiling set — dtype-aware: hw ≤ 1024 for fp32 (32²
+    compiled), hw ≤ 256 for bf16 (32² bf16 wedged the helper); "0"
+    disables even under a future default-on.
     """
     import os
 
     force = os.environ.get("VFT_FUSED_WARP_CORR")
     if force == "1":
-        return h * w <= 256
+        # dtype-aware cap: 32² (hw=1024) compiled in fp32 but WEDGED the
+        # Mosaic helper for 30+ min in bf16 — bf16 stays at the tighter bound
+        return h * w <= (1024 if jnp.dtype(dtype) == jnp.float32 else 256)
     return False
 
 
