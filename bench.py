@@ -336,8 +336,13 @@ def main() -> None:
             # (and the run_failures slot) when THIS run re-measures a config.
             prev_rev = prev.get("code_rev")
             for k, v in prev.items():
-                if isinstance(v, dict) and "code_rev" not in v and (
-                        "value" in v or "videos_per_sec" in v or "failed" in v):
+                # only stamp when the prior run's rev is KNOWN — a null
+                # stamp would permanently mask the provenance (the
+                # "code_rev" not in v guard keeps later runs from
+                # overwriting an existing stamp)
+                if prev_rev and isinstance(v, dict) and "code_rev" not in v \
+                        and ("value" in v or "videos_per_sec" in v
+                             or "failed" in v):
                     v["code_rev"] = prev_rev
             prev.update(details)
             details = prev

@@ -104,9 +104,14 @@ def test_maxpool_tf_same_matches_torch_ceilmode():
 
 
 @pytest.mark.parametrize("t,h,w", [(16, 224, 224), (16, 63, 57)])
-def test_s2d_stem_matches_direct_conv(converted, modality, t, h, w):
+def test_s2d_stem_matches_direct_conv(converted, modality, t, h, w, monkeypatch):
     """Space-to-depth stem lowering == direct stem conv (same params; the
-    folded taps only add zero products, so fp32 CPU agrees to ~1e-5)."""
+    folded taps only add zero products, so fp32 CPU agrees to ~1e-5).
+
+    Pins VFT_I3D_TAP_FP32 off: this asserts the DEFAULT fp32 lowering pair;
+    under the tap flag the conv3ds reassociate and the measured drift
+    (max rel ~3e-5, round 5) is exactly what the flag's docs warn about."""
+    monkeypatch.delenv("VFT_I3D_TAP_FP32", raising=False)
     _, params = converted
     c = {"rgb": 3, "flow": 2}[modality]
     x = jnp.asarray(

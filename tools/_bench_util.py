@@ -21,9 +21,20 @@ timeit = _bench._timeit
 
 
 def enable_compilation_cache():
-    """Tunnel compiles dominate wall time; reuse bench.py's persistent cache."""
+    """Tunnel compiles dominate wall time; reuse bench.py's persistent cache.
+
+    Also honors an explicit JAX_PLATFORMS (e.g. a cpu sanity run) through the
+    config API — the image's sitecustomize pins the axon platform, so the env
+    var alone would still dial the TPU tunnel (and hang for ~50 min when the
+    tunnel is down)."""
     import jax
 
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
     try:
         jax.config.update("jax_compilation_cache_dir",
                           os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache"))

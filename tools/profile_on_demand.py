@@ -1,0 +1,138 @@
+"""Measure RAFT's HBM-bounded correlation paths at big-frame geometry.
+
+``on_demand`` (patch gather from pooled f2 — the round-3 path, measured ~40×
+slower than ``volume``) vs ``on_demand_matmul`` (round 5: rematerialize each
+query chunk's slice of the correlation volume per iteration on the MXU, zero
+gathers — models/raft.py::_lookup_on_demand impl='matmul').
+
+Default geometry 1080×1920 (one pair): 1/8-res grid 135×240 → the pyramid
+would need ~5.6 GB fp32, past the 4 GiB auto budget — exactly the regime
+``auto`` resolves to on_demand (resolve_corr_impl docstring). ``--small``
+swaps in 512² (volume fits; all three impls comparable) for a cross-check
+against the volume path's numbers.
+
+Results append to ``tools/on_demand_profile.json`` with the same device +
+code_rev merge contract as profile_warp_corr.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+
+from tools._bench_util import enable_compilation_cache, time_fn  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--small", action="store_true",
+                    help="512² geometry (volume fits; 3-way comparison)")
+    ap.add_argument("--size", default=None,
+                    help="explicit HxW override (e.g. 64x64 for a CPU sanity run)")
+    ap.add_argument("--impls", default=None,
+                    help="comma-separated subset of volume,on_demand,"
+                         "on_demand_matmul (default: geometry-appropriate)")
+    ap.add_argument("--iters", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    enable_compilation_cache()
+    print(f"backend: {jax.default_backend()} {jax.devices()[0]}", flush=True)
+
+    from video_features_tpu.models.raft import raft_forward, raft_init_params
+
+    if args.size:
+        h, w = (int(v) for v in args.size.split("x"))
+    else:
+        h, w = (512, 512) if args.small else (1080, 1920)
+    h8, w8 = -(-h // 8) * 8, -(-w // 8) * 8  # the extractor's /8 pad
+    impls = (args.impls.split(",") if args.impls else
+             (["volume", "on_demand", "on_demand_matmul"] if args.small
+              else ["on_demand_matmul", "on_demand"]))
+
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "on_demand_profile.json")
+    device = str(jax.devices()[0])
+    try:
+        code_rev = subprocess.check_output(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            text=True).strip()
+    except Exception:
+        code_rev = "unknown"
+    results = {}
+    try:
+        with open(out_path) as f:
+            prev = json.load(f)
+        if prev.get("device") == device and prev.get("code_rev") == code_rev:
+            results = prev
+    except Exception:
+        pass
+    results["device"] = device
+    results["code_rev"] = code_rev
+
+    def flush():
+        with open(out_path + ".tmp", "w") as f:
+            json.dump(results, f, indent=2)
+        os.replace(out_path + ".tmp", out_path)
+
+    rng = np.random.default_rng(0)
+    params = jax.device_put(raft_init_params(seed=0))
+
+    for dtype_name, dtype in (("bfloat16", jnp.bfloat16),
+                              ("float32", jnp.float32)):
+        ref = None
+        # ONE fixed input pair per dtype: the cross-impl drift check must
+        # compare flows computed on the SAME frames
+        cmp_rng = np.random.default_rng(7)
+        cmp_a = jnp.asarray(cmp_rng.uniform(0, 255, (1, h8, w8, 3))
+                            .astype(np.float32))
+        cmp_b = jnp.asarray(cmp_rng.uniform(0, 255, (1, h8, w8, 3))
+                            .astype(np.float32))
+        ref_impl = None
+        for impl in impls:
+            name = f"raft_1x{h8}x{w8}_{dtype_name}_{impl}"
+            try:
+                step = jax.jit(functools.partial(
+                    raft_forward, corr_impl=impl, dtype=dtype))
+
+                def mk():
+                    a = jnp.asarray(rng.uniform(0, 255, (1, h8, w8, 3))
+                                    .astype(np.float32))
+                    b = jnp.asarray(rng.uniform(0, 255, (1, h8, w8, 3))
+                                    .astype(np.float32))
+                    return params, a, b
+
+                sec = time_fn(name, step, mk, iters=args.iters)
+                results[name] = round(sec * 1e3, 2)  # ms per pair
+                flow = np.asarray(step(params, cmp_a, cmp_b), dtype=np.float32)
+                if ref is None:
+                    # the drift reference is the first impl that SUCCEEDED —
+                    # label with its actual name, not impls[0]
+                    ref, ref_impl = flow, impl
+                else:
+                    results[f"{name}_max_px_diff_vs_{ref_impl}"] = round(
+                        float(np.abs(flow - ref).max()), 5)
+            except Exception as e:  # noqa: BLE001 — per-config barrier
+                results[name] = f"FAILED: {str(e)[:200]}"
+                print(f"{name}: FAILED {str(e)[:160]}", flush=True)
+            flush()
+
+    print(json.dumps({k: v for k, v in results.items()
+                      if isinstance(v, (int, float))}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -158,18 +158,35 @@ def main() -> None:
 
     params = pwc_init_params(seed=0)
     params = jax.device_put(params)
-    # auto_nofused isolates the fused warp+corr contribution: VFT_FUSED_WARP_CORR=0
-    # keeps the tiled/single-block corr kernels but warps via the XLA gather.
-    # A user-exported VFT_FUSED_WARP_CORR is saved and restored around each
-    # config (it is also a documented external override of the same gate).
-    user_fused = os.environ.get("VFT_FUSED_WARP_CORR")
+    # The round-5 decision matrix for the PWC floor. `auto` (production
+    # default) is the gather warp + Pallas volume composition — the fused
+    # kernel is OFF under auto until this sweep proves it, so `auto` IS the
+    # round-4 "auto_nofused" baseline. The env-tagged configs flip one
+    # lowering each: the fused Pallas warp+corr at its admitted levels
+    # (VFT_FUSED_WARP_CORR=1), the one-hot MXU warp at ALL levels
+    # (VFT_WARP_IMPL=onehot, ops/warp.bilinear_sample_onehot), and both —
+    # onehot covering the levels the Mosaic cliff keeps from the fused
+    # kernel. User-exported values of both env vars are saved/restored.
+    saved_env = {k: os.environ.get(k)
+                 for k in ("VFT_FUSED_WARP_CORR", "VFT_WARP_IMPL")}
+    matrix = (
+        ("xla", "xla", {}),
+        ("auto", "auto", {}),
+        ("auto", "auto_fused", {"VFT_FUSED_WARP_CORR": "1"}),
+        ("auto", "auto_onehot", {"VFT_WARP_IMPL": "onehot"}),
+        ("auto", "auto_onehot_fused", {"VFT_WARP_IMPL": "onehot",
+                                       "VFT_FUSED_WARP_CORR": "1"}),
+    )
     for dtype_name, dtype in (("float32", jnp.float32), ("bfloat16", jnp.bfloat16)):
-        for impl, tag, fused_env in (("xla", "xla", None),
-                                     ("auto", "auto", None),
-                                     ("auto", "auto_nofused", "0")):
+        for impl, tag, env in matrix:
             name = f"pwc_frames17_256_{dtype_name}_{tag}"
-            if fused_env is not None:
-                os.environ["VFT_FUSED_WARP_CORR"] = fused_env
+            # clear BOTH knobs first: a user-exported VFT_WARP_IMPL or
+            # VFT_FUSED_WARP_CORR must not leak into configs that don't set
+            # it, or the baseline rows get measured with the wrong lowering
+            for k in saved_env:
+                os.environ.pop(k, None)
+            for k, v in env.items():
+                os.environ[k] = v
             try:
                 step = jax.jit(functools.partial(
                     pwc_forward_frames, corr_impl=impl, dtype=dtype))
@@ -184,10 +201,11 @@ def main() -> None:
                 results[name] = f"FAILED: {str(e)[:200]}"
                 print(f"{name}: FAILED {str(e)[:160]}", flush=True)
             finally:
-                if user_fused is None:
-                    os.environ.pop("VFT_FUSED_WARP_CORR", None)
-                else:
-                    os.environ["VFT_FUSED_WARP_CORR"] = user_fused
+                for k, v in saved_env.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
             flush()
 
     print(json.dumps(results), flush=True)

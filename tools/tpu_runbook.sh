@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-5 TPU measurement runbook — run when the axon tunnel is up.
+# Ordered most-important-first so a mid-run tunnel drop still lands the
+# headline record. Logs to /tmp/runbook/; each tool merge-updates its own
+# JSON record (bench_details.json / warp_corr_profile.json /
+# on_demand_profile.json) so partial runs refine rather than clobber.
+set -u
+cd "$(dirname "$0")/.."
+export JAX_COMPILATION_CACHE_DIR=${JAX_COMPILATION_CACHE_DIR:-/tmp/jax_cache}
+L=/tmp/runbook
+mkdir -p "$L"
+run() {  # run <tag> <cmd...>
+  echo "=== $1 start $(date -u +%H:%M:%S) ===" | tee -a "$L/runbook.log"
+  shift
+  "$@" > "$L/$1.log" 2>&1
+  echo "=== rc=$? end $(date -u +%H:%M:%S) ===" | tee -a "$L/runbook.log"
+}
+# 1. the round's must-have: headline + device-step + e2e entries
+run bench env VFT_BENCH_BUDGET=2400 python bench.py
+# 2. PWC floor decision: per-level (cheap levels) + whole-forward matrix
+#    (auto / auto_fused / auto_onehot / auto_onehot_fused)
+run warpcorr python tools/profile_warp_corr.py --levels 5,4 --forward
+# 3. RAFT big-frame paths: on_demand_matmul vs on_demand at 1080p
+run ondemand python tools/profile_on_demand.py
+# 4. I3D clips_per_batch knee at 224² (verdict item 5)
+run i3d_c8 python tools/profile_i3d.py 8 64
+run i3d_c16 python tools/profile_i3d.py 16 64
+echo "RUNBOOK COMPLETE $(date -u)" | tee -a "$L/runbook.log"

@@ -162,13 +162,18 @@ class ExtractFlow(Extractor):
         if self._async_copy_ok:
             try:
                 flow.copy_to_host_async()
-            except (AttributeError, NotImplementedError):
-                # backend lacks async host copy — probe once, note it, and
-                # stop trying (a blanket pass here once swallowed genuine
-                # transfer errors whose context only resurfaced at _wait)
+            except Exception as e:  # noqa: BLE001 — see below
+                # backend lacks async host copy (AttributeError /
+                # NotImplementedError / backend-specific UNIMPLEMENTED
+                # runtime errors) — probe once, disarm, and say WHICH error
+                # disarmed it, so a genuine transfer fault is visible here
+                # instead of resurfacing context-free at _wait (the old
+                # blanket `pass` hid it; crashing extraction on an optional
+                # optimization would be worse)
                 self._async_copy_ok = False
-                print("[flow] backend has no copy_to_host_async; D2H "
-                      "transfers will not overlap compute", flush=True)
+                print(f"[flow] async D2H disabled after "
+                      f"{type(e).__name__}: {e}; transfers will not "
+                      f"overlap compute", flush=True)
         return flow, n_pairs, pads
 
     def _collect_pairs(self, handle) -> np.ndarray:
