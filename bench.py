@@ -269,12 +269,14 @@ def main() -> None:
     from video_features_tpu.extractors.i3d import ExtractI3D
     from video_features_tpu.extractors.resnet import ExtractResNet50
 
-    if os.environ.pop("VFT_I3D_TAP_FP32", None) is not None:
-        # a pre-set flag would silently tap-lower every fp32 I3D config,
-        # including the bit-parity headline; bench entries must be single-
-        # lowering — the flag is applied only to i3d_rgb_float32_tapconv
-        _log("VFT_I3D_TAP_FP32 was set in the environment; cleared — bench "
-             "applies it only to the i3d_rgb_float32_tapconv config")
+    for flag in ("VFT_I3D_TAP_FP32", "VFT_I3D_S2D"):
+        if os.environ.pop(flag, None) is not None:
+            # a pre-set flag would silently re-lower every fp32 I3D config,
+            # including the bit-parity headline; bench entries must be
+            # single-lowering — each flag applies only to its own
+            # i3d_rgb_float32_{tapconv,s2d} config
+            _log(f"{flag} was set in the environment; cleared — bench "
+                 f"applies it only to its dedicated stem config")
 
     backend = _backend_or_none(
         retries=int(os.environ.get("VFT_BENCH_INIT_RETRIES", 3)),
@@ -334,15 +336,14 @@ def main() -> None:
             # older code revision must not read as current data — stamp each
             # with the rev it was measured at. record() overwrites the stamp
             # (and the run_failures slot) when THIS run re-measures a config.
-            prev_rev = prev.get("code_rev")
+            # a pre-code_rev record stamps "unknown": leaving it unstamped
+            # would let a LATER run mis-attribute these entries to its own
+            # predecessor's rev (the "code_rev" not in v guard only works
+            # if every pass stamps something truthful)
+            prev_rev = prev.get("code_rev") or "unknown"
             for k, v in prev.items():
-                # only stamp when the prior run's rev is KNOWN — a null
-                # stamp would permanently mask the provenance (the
-                # "code_rev" not in v guard keeps later runs from
-                # overwriting an existing stamp)
-                if prev_rev and isinstance(v, dict) and "code_rev" not in v \
-                        and ("value" in v or "videos_per_sec" in v
-                             or "failed" in v):
+                if isinstance(v, dict) and "code_rev" not in v and (
+                        "value" in v or "videos_per_sec" in v or "failed" in v):
                     v["code_rev"] = prev_rev
             prev.update(details)
             details = prev
@@ -483,29 +484,34 @@ def main() -> None:
                 headline = e
                 print_summary()  # headline secured — a later kill loses nothing
 
-    # fp32 stem through the TapConv3D lowering (VFT_I3D_TAP_FP32 — joint-
-    # extent convs only; reassociates the temporal sum, hence not the
-    # bit-parity headline). The stem is 21 of 33 ms (docs/architecture.md).
-    if not on_cpu and not over_budget("i3d_rgb_float32_tapconv"):
-        os.environ["VFT_I3D_TAP_FP32"] = "1"
+    # fp32 stem lowering candidates (the stem is 21 of 33 ms —
+    # docs/architecture.md): TapConv3D (VFT_I3D_TAP_FP32 — reassociates the
+    # temporal sum) and the space-to-depth stem (VFT_I3D_S2D — folded taps
+    # add only zero products, ~1e-5 drift). Neither is the bit-parity
+    # headline; whichever wins informs the default-flip decision.
+    for tag, env_key in (("tapconv", "VFT_I3D_TAP_FP32"), ("s2d", "VFT_I3D_S2D")):
+        name = f"i3d_rgb_float32_{tag}"
+        if on_cpu or over_budget(name):
+            continue
+        os.environ[env_key] = "1"
         try:
-            with guarded("i3d_rgb_float32_tapconv"):
+            with guarded(name):
                 ex = ExtractI3D(cfg("i3d", streams=("rgb",), stack_size=stack,
                                     step_size=stack, clips_per_batch=clips,
                                     dtype="float32"))
 
-                def mk_tap(ex=ex):
+                def mk_stem(ex=ex):
                     return (ex.i3d_params["rgb"],
                             ex.runner.put(rng.integers(
                                 0, 256, (ex.clips_per_batch, stack + 1, 256, 256, 3),
                                 dtype=np.uint8)))
 
-                timing = _time_step(ex._rgb_step, mk_tap, iters, _repeats(on_cpu))
-                record("i3d_rgb_float32_tapconv", timing,
+                timing = _time_step(ex._rgb_step, mk_stem, iters, _repeats(on_cpu))
+                record(name, timing,
                        ex.clips_per_batch * stack / 64.0, "clips/sec/chip",
-                       _flops_of(ex._rgb_step, *mk_tap()))
+                       _flops_of(ex._rgb_step, *mk_stem()))
         finally:
-            del os.environ["VFT_I3D_TAP_FP32"]
+            del os.environ[env_key]
 
     # ---- I3D-flow composites: flow net + transform sandwich + I3D, one step ----
     # pwc is the reference's default flow for i3d (main.py:72-73); raft is the

@@ -311,8 +311,11 @@ def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
             continue
         ix, iy, fx, fy = _int_window((coords / 2**i).reshape(b, n, 2))
         if impl == "matmul":
-            chunk = int(max(1, min(n, chunk_budget // (hi * wi))))
-            n_chunks = -(-n // chunk)
+            # equalized chunks (see ops/warp.bilinear_sample_onehot): a bare
+            # ceil-cap can nearly double the padded tail chunk's work
+            cap = int(max(1, min(n, chunk_budget // (hi * wi))))
+            n_chunks = -(-n // cap)
+            chunk = -(-n // n_chunks)
             pad = n_chunks * chunk - n
 
             def prep(a):  # (b, n, ...) → (n_chunks, b, chunk, ...)
