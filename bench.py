@@ -184,6 +184,15 @@ def _backend_or_none(retries: int, wait_sec: float,
                 if line.startswith("BACKEND="):
                     import jax
 
+                    # apply the same JAX_PLATFORMS redirect the probe did —
+                    # the sitecustomize pin means the env var alone would
+                    # still init the pinned platform in-process
+                    want = os.environ.get("JAX_PLATFORMS")
+                    if want:
+                        try:
+                            jax.config.update("jax_platforms", want)
+                        except Exception:
+                            pass
                     return jax.default_backend()  # probe ok → real init
             why = (out.stderr.strip().splitlines() or ["no backend line"])[-1]
         except subprocess.TimeoutExpired:

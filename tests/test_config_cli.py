@@ -73,11 +73,13 @@ def test_cli_tpu_knobs_round2():
     cfg = parse_args([
         "--feature_type", "raft", "--video_paths", "a.mp4",
         "--raft_corr", "on_demand", "--pwc_corr", "pallas",
+        "--pwc_warp", "onehot",
         "--matmul_precision", "highest", "--profile_dir", "/tmp/trace",
         "--clips_per_batch", "8", "--dtype", "bfloat16",
     ])
     assert cfg.raft_corr == "on_demand"
     assert cfg.pwc_corr == "pallas"
+    assert cfg.pwc_warp == "onehot"
     assert cfg.matmul_precision == "highest"
     assert cfg.profile_dir == "/tmp/trace"
     assert cfg.clips_per_batch == 8
@@ -93,6 +95,8 @@ def test_config_rejects_bad_round2_values():
         ExtractionConfig(feature_type="raft", raft_corr="cuda").validate()
     with pytest.raises(ValueError):
         ExtractionConfig(feature_type="pwc", pwc_corr="cupy").validate()
+    with pytest.raises(ValueError):
+        ExtractionConfig(feature_type="pwc", pwc_warp="bilinear").validate()
     with pytest.raises(ValueError):
         ExtractionConfig(feature_type="i3d", matmul_precision="bf16").validate()
 

@@ -81,6 +81,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="PWC cost-volume implementation: auto picks the "
                              "Pallas tile kernel where its VMEM gate admits "
                              "the shape, else the fused XLA formulation")
+    parser.add_argument("--pwc_warp", choices=["auto", "gather", "onehot"],
+                        default="auto",
+                        help="PWC backward-warp lowering: gather corner taps "
+                             "or one-hot MXU selector matmuls (covers the "
+                             "levels the Mosaic cliff bars from the fused "
+                             "kernel); auto defers to VFT_WARP_IMPL")
     parser.add_argument("--flow_pair_chunk", type=int, default=None,
                         help="i3d flow sandwich: decode PWC pairs in sub-batches "
                              "of this size to bound HBM (default: auto; 0 = never; "

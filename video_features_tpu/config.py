@@ -79,6 +79,12 @@ class ExtractionConfig:
     # bench_details.json pwc_pairs_*) and the fused XLA formulation elsewhere;
     # "xla"/"pallas" force a path (ops/pallas_corr).
     pwc_corr: str = "auto"
+    # PWC backward-warp lowering: "gather" (take_along_axis corner taps) or
+    # "onehot" (MXU selector matmuls, ops/warp.bilinear_sample_onehot —
+    # covers the levels the Mosaic compile cliff bars from the fused
+    # kernel). "auto" (default) defers to VFT_WARP_IMPL, unset -> gather,
+    # pending the TPU decision sweep (tools/profile_warp_corr.py --forward).
+    pwc_warp: str = "auto"
     # I3D flow sandwich: decode the PWC pairs in sub-batches of this size
     # under lax.map to bound peak decoder memory (the 64-pair stack at the
     # sample videos' 256×341 geometry exceeds HBM in one piece). None = auto
@@ -155,6 +161,8 @@ class ExtractionConfig:
                 "raft_corr must be auto|volume|volume_gather|on_demand|on_demand_matmul")
         if self.pwc_corr not in ("auto", "xla", "pallas"):
             raise ValueError("pwc_corr must be auto|xla|pallas")
+        if self.pwc_warp not in ("auto", "gather", "onehot"):
+            raise ValueError("pwc_warp must be auto|gather|onehot")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError("matmul_precision must be default|high|highest")
         if self.decode_workers < 1:

@@ -93,9 +93,11 @@ class ExtractFlow(Extractor):
                 )
             )
             self._forward = functools.partial(
-                pwc_forward, corr_impl=cfg.pwc_corr, dtype=flow_dtype)
+                pwc_forward, corr_impl=cfg.pwc_corr, dtype=flow_dtype,
+                warp_impl=cfg.pwc_warp)
             self._forward_frames = functools.partial(
-                pwc_forward_frames, corr_impl=cfg.pwc_corr, dtype=flow_dtype)
+                pwc_forward_frames, corr_impl=cfg.pwc_corr, dtype=flow_dtype,
+                warp_impl=cfg.pwc_warp)
             self._pads_input = False
         else:
             raise ValueError(f"not a flow feature type: {self.feature_type}")

@@ -154,6 +154,7 @@ class ExtractI3D(Extractor):
                       else jnp.float32)
         raft_corr = self.cfg.raft_corr
         pwc_corr = self.cfg.pwc_corr
+        pwc_warp = self.cfg.pwc_warp
         flow_pair_chunk = self.cfg.flow_pair_chunk
         crop = self.crop_size
         n_devices = self.runner.num_devices
@@ -191,7 +192,8 @@ class ExtractI3D(Extractor):
                     chunk = 16 if total * h64 * w64 > 5_000_000 else None
                 flow = pwc_forward_frames(flow_params, frames,
                                           corr_impl=pwc_corr, dtype=flow_dtype,
-                                          pair_chunk=chunk)
+                                          pair_chunk=chunk,
+                                          warp_impl=pwc_warp)
             # flow: (N, S, Hp, Wp, 2)
             x = i3d_preprocess_flow(_center_crop_nhwc(flow, crop), dtype=dtype)
             feats = model.apply({"params": params}, x, features=True)

@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 
 from ..ops.nnf import avg_pool2d, batch_norm_eval, conv2d, instance_norm
-from ..ops.warp import coords_grid
+from ..ops.warp import coords_grid, equalize_chunks
 
 HIDDEN_DIM = 128
 CONTEXT_DIM = 128
@@ -311,12 +311,7 @@ def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
             continue
         ix, iy, fx, fy = _int_window((coords / 2**i).reshape(b, n, 2))
         if impl == "matmul":
-            # equalized chunks (see ops/warp.bilinear_sample_onehot): a bare
-            # ceil-cap can nearly double the padded tail chunk's work
-            cap = int(max(1, min(n, chunk_budget // (hi * wi))))
-            n_chunks = -(-n // cap)
-            chunk = -(-n // n_chunks)
-            pad = n_chunks * chunk - n
+            n_chunks, chunk, pad = equalize_chunks(n, chunk_budget // (hi * wi))
 
             def prep(a):  # (b, n, ...) → (n_chunks, b, chunk, ...)
                 a = jnp.pad(a, ((0, 0), (0, pad)) + ((0, 0),) * (a.ndim - 2))

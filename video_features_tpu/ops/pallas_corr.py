@@ -411,15 +411,19 @@ def _fused_compile_ok(h: int, w: int, dtype) -> bool:
 
 
 def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
-                impl: str = "xla") -> jnp.ndarray:
+                impl: str = "xla", warp_impl: str = "auto") -> jnp.ndarray:
     """Backward-warp ``f2`` by ``flow`` (already level-scaled) and correlate.
 
-    ``xla``: the two-stage composition (gather warp → fused-XLA volume).
+    ``impl`` — ``xla``: the two-stage composition (warp → fused-XLA volume).
     ``auto``/``pallas``: the fused kernel where the VMEM gate and the compile
     allowlist admit the shape; otherwise the composition with ``corr81(impl)``
     — which itself takes the tiled Pallas volume kernel where supported (the
     round-3 measured win). ``pallas_interpret``: fused kernel in the Pallas
     interpreter (CPU tests).
+
+    ``warp_impl`` — the composition's warp lowering: ``gather`` | ``onehot``
+    (MXU selector matmuls, ops/warp.bilinear_sample_onehot) | ``auto``
+    (VFT_WARP_IMPL, unset → gather).
     """
     from .warp import warp_backward
 
@@ -431,7 +435,7 @@ def warp_corr81(f1: jnp.ndarray, f2: jnp.ndarray, flow: jnp.ndarray,
         if _fused_compile_ok(h, w, f1.dtype) and \
                 _warp_corr_supported(b, h, w, c, jnp.dtype(f1.dtype).itemsize):
             return warp_corr81_pallas(f1, f2, flow)
-    return corr81(f1, warp_backward(f2, flow), impl)
+    return corr81(f1, warp_backward(f2, flow, warp_impl), impl)
 
 
 def corr81(f1: jnp.ndarray, f2: jnp.ndarray, impl: str = "xla") -> jnp.ndarray:

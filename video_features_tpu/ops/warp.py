@@ -57,6 +57,21 @@ def bilinear_sample(img: jnp.ndarray, coords_xy: jnp.ndarray) -> jnp.ndarray:
     return out
 
 
+def equalize_chunks(n: int, cap: int) -> tuple[int, int, int]:
+    """Split ``n`` items into equal chunks of at most ``cap``.
+
+    Returns ``(n_chunks, chunk, pad)`` with ``chunk ≤ cap`` and
+    ``n_chunks · chunk = n + pad``. Equalized (vs bare ceil-capping) so an
+    unlucky ``n``/``cap`` ratio cannot nearly double the padded tail's work
+    (e.g. n=4096, cap=3787 → two 3787-chunks would be 45 % padding; this
+    yields two 2048-chunks). Shared by every budget-chunked query loop
+    (the one-hot warp here, RAFT's on-demand matmul lookup)."""
+    cap = max(1, min(n, cap))
+    n_chunks = -(-n // cap)
+    chunk = -(-n // n_chunks)
+    return n_chunks, chunk, n_chunks * chunk - n
+
+
 def bilinear_sample_onehot(img: jnp.ndarray, coords_xy: jnp.ndarray,
                            chunk_budget: int = 8_000_000) -> jnp.ndarray:
     """:func:`bilinear_sample` on the MXU — weighted one-hot selector matmuls
@@ -104,13 +119,8 @@ def bilinear_sample_onehot(img: jnp.ndarray, coords_xy: jnp.ndarray,
     prec = lax.Precision.DEFAULT if bf16 else lax.Precision.HIGHEST
 
     # chunk the query axis: the (n, chunk, w, c) row intermediate is the
-    # peak buffer; hold it to ~chunk_budget elements per batch element.
-    # Equalized chunks: ceil-capping alone can waste ~2× in pad compute
-    # (e.g. q=4096 with cap 3787 → two 3787-chunks, 45 % padding)
-    cap = max(1, min(q, chunk_budget // max(w * c, 1)))
-    n_chunks = -(-q // cap)
-    chunk = -(-q // n_chunks)
-    pad = n_chunks * chunk - q
+    # peak buffer; hold it to ~chunk_budget elements per batch element
+    n_chunks, chunk, pad = equalize_chunks(q, chunk_budget // max(w * c, 1))
 
     def prep(a):
         a = jnp.pad(a, ((0, 0), (0, pad)))
@@ -143,13 +153,13 @@ def warp_backward(img: jnp.ndarray, flow: jnp.ndarray,
     sampled value is ≤ 0.999 (any out-of-bounds leakage) the whole output pixel is
     zeroed, otherwise scaled by exactly 1.0.
 
-    ``impl``: ``gather`` (default — the take_along_axis corner taps) or
-    ``onehot`` (:func:`bilinear_sample_onehot`, MXU selector matmuls). When
-    None, ``VFT_WARP_IMPL`` selects (unset → gather).
+    ``impl``: ``gather`` (the take_along_axis corner taps) or ``onehot``
+    (:func:`bilinear_sample_onehot`, MXU selector matmuls). When None or
+    ``auto``, ``VFT_WARP_IMPL`` selects (unset → gather).
 
     ``img`` (N, H, W, C); ``flow`` (N, H, W, 2) in pixels (u, v). Returns (N, H, W, C).
     """
-    if impl is None:
+    if impl is None or impl == "auto":
         impl = os.environ.get("VFT_WARP_IMPL", "gather")
     n, h, w, _ = flow.shape
     ys, xs = jnp.meshgrid(jnp.arange(h, dtype=jnp.float32),
