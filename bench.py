@@ -148,6 +148,24 @@ def _git_rev() -> str | None:
         return None
 
 
+def _apply_platform_redirect() -> None:
+    """Apply JAX_PLATFORMS through the config API — the image's sitecustomize
+    pins the axon platform there, so the env var alone does not redirect. A
+    failed redirect is LOGGED (not swallowed): falling through silently would
+    initialize the pinned platform in-process, the unbounded tunnel hang this
+    file's probe architecture exists to prevent."""
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", want)
+    except Exception as e:  # noqa: BLE001
+        _log(f"WARNING: could not redirect jax_platforms to {want!r} "
+             f"({e}); the pinned platform may be initialized instead")
+
+
 def _backend_or_none(retries: int, wait_sec: float,
                      probe_timeout: float | None = None) -> str | None:
     """Establish the JAX backend within a bounded wall-clock window.
@@ -184,15 +202,8 @@ def _backend_or_none(retries: int, wait_sec: float,
                 if line.startswith("BACKEND="):
                     import jax
 
-                    # apply the same JAX_PLATFORMS redirect the probe did —
-                    # the sitecustomize pin means the env var alone would
-                    # still init the pinned platform in-process
-                    want = os.environ.get("JAX_PLATFORMS")
-                    if want:
-                        try:
-                            jax.config.update("jax_platforms", want)
-                        except Exception:
-                            pass
+                    # same redirect the probe subprocess applied
+                    _apply_platform_redirect()
                     return jax.default_backend()  # probe ok → real init
             why = (out.stderr.strip().splitlines() or ["no backend line"])[-1]
         except subprocess.TimeoutExpired:
@@ -255,12 +266,7 @@ def main() -> None:
 
     # the image's sitecustomize pins the axon TPU platform; honor an explicit
     # JAX_PLATFORMS=cpu (CPU smoke run) the way main.py does
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
+    _apply_platform_redirect()
     # persistent compilation cache: TPU compiles go over the tunnel and dominate
     # bench wall time; cache them so reruns (and the driver's run) skip straight
     # to execution
