@@ -93,3 +93,34 @@ def test_flow_dtype_plumbs_through_extractor(tmp_path, monkeypatch):
     # ...but only slightly
     scale = np.abs(outs["float32"]).max() + 1e-6
     assert np.abs(outs["float32"] - outs["bfloat16"]).max() <= 0.05 * scale
+
+
+def test_raft_on_demand_matmul_bf16_drift_bounded(frames):
+    """bf16 on_demand_matmul (bf16 vol-einsum inputs, fp32 accumulation) vs
+    the fp32 gather on-demand path: same drift class as the volume path's
+    bf16 pyramid storage — one bf16 rounding of the lookup input."""
+    x1, x2 = frames
+    params = raft_init_params(0)
+    f32 = np.asarray(raft_forward(params, x1, x2, iters=8,
+                                  corr_impl="on_demand"))
+    bf16 = np.asarray(raft_forward(params, x1, x2, iters=8,
+                                   corr_impl="on_demand_matmul",
+                                   dtype=jnp.bfloat16))
+    err = np.abs(bf16 - f32)
+    scale = np.abs(f32).max() + 1e-6
+    assert err.max() <= 0.05 * scale + 1e-3, (err.max(), scale)
+    # the dtype plumbing is LIVE: a direct lookup in bf16 must differ from
+    # fp32 (else a silent revert of the bf16 vol-einsum passes the bound
+    # above on conv drift alone)
+    from video_features_tpu.models.raft import (
+        _build_f2_pyramid, _lookup_on_demand, _encoder, coords_grid)
+
+    f1 = _encoder(params["fnet"], 2.0 * (x1 / 255.0) - 1.0, "instance")
+    f2 = _encoder(params["fnet"], 2.0 * (x2 / 255.0) - 1.0, "instance")
+    pyr = _build_f2_pyramid(f2.astype(jnp.float32))
+    coords = coords_grid(*f1.shape[:3])
+    a = np.asarray(_lookup_on_demand(f1, pyr, coords, "matmul"))
+    b = np.asarray(_lookup_on_demand(f1, pyr, coords, "matmul",
+                                     dtype=jnp.bfloat16))
+    assert np.abs(a - b).max() > 0, "bf16 vol-einsum plumbing is dead"
+    assert np.allclose(a, b, rtol=0.03, atol=0.03 * np.abs(a).max())

@@ -267,7 +267,8 @@ def _build_f2_pyramid(f2: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
 
 def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
                       impl: str = "gather",
-                      chunk_budget: int = 16_000_000) -> jnp.ndarray:
+                      chunk_budget: int = 16_000_000,
+                      dtype=jnp.float32) -> jnp.ndarray:
     """Correlation window computed on the fly from pooled-f2 features — the
     memory-bounded path (O(H·W·D), no persistent (H·W)² volume).
 
@@ -291,6 +292,15 @@ def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
     it trades the same FLOPs for the O((H·W)²) HBM the big-frame regime
     doesn't have. Reference anchor: ``alt_cuda_corr``
     (/root/reference/models/raft/corr.py:63-91) recomputes per-iteration too.
+
+    ``dtype=bfloat16`` (matmul impl only): the vol einsum's INPUTS are cast
+    bf16 (fp32 accumulation via preferred_element_type) — halves the remat's
+    HBM reads and runs single-pass on the MXU instead of the fp32 3-pass
+    default. Same drift class as the volume path's bf16 pyramid storage
+    (that path rounds the correlation values AFTER the product; this rounds
+    the features BEFORE — both one bf16 rounding of the lookup input,
+    bounded in tests/test_flow_bf16.py). The gather impl stays fp32: its
+    cost is the gather, not the contraction.
     """
     if impl not in ("gather", "matmul"):
         raise ValueError(
@@ -317,15 +327,19 @@ def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
                 a = jnp.pad(a, ((0, 0), (0, pad)) + ((0, 0),) * (a.ndim - 2))
                 return a.reshape((b, n_chunks, chunk) + a.shape[2:]).swapaxes(0, 1)
 
-            f2f = f2i.astype(jnp.float32)
+            vol_in = jnp.bfloat16 if dtype == jnp.bfloat16 else jnp.float32
+            f2f = f2i.astype(vol_in)
             iota_h = jnp.arange(hi, dtype=jnp.int32)
             iota_w = jnp.arange(wi, dtype=jnp.int32)
 
             def body(_, args):
                 f1c, ixc, iyc = args  # (b, chunk, d), (b, chunk, 10), ...
-                # DEFAULT precision: the same contraction precision the
-                # gather impl's f1·patch einsum runs at
-                vol = jnp.einsum("bnc,bijc->bnij", f1c, f2f)
+                # fp32 mode: DEFAULT precision — the same contraction
+                # precision the gather impl's f1·patch einsum runs at.
+                # bf16 mode: bf16 inputs (pre-cast below, so the scanned f1
+                # slices are read half-width too), fp32 accumulator
+                vol = jnp.einsum("bnc,bijc->bnij", f1c, f2f,
+                                 preferred_element_type=jnp.float32)
                 sy = (iyc[..., None] == iota_h).astype(jnp.float32)
                 sx = (ixc[..., None] == iota_w).astype(jnp.float32)
                 # HIGHEST: one-hot selection must pass vol values through
@@ -337,8 +351,9 @@ def _lookup_on_demand(f1: jnp.ndarray, f2_pyramid, coords: jnp.ndarray,
                                    precision=lax.Precision.HIGHEST)
                 return None, patch * scale
 
-            _, patch = lax.scan(body, None,
-                                (prep(f1.reshape(b, n, d)), prep(ix), prep(iy)))
+            _, patch = lax.scan(
+                body, None,
+                (prep(f1.reshape(b, n, d).astype(vol_in)), prep(ix), prep(iy)))
             patch = patch.swapaxes(0, 1).reshape(b, n_chunks * chunk,
                                                  win, win)[:, :n]
             # OOB taps already zero (equality falls off the iota) — same
@@ -504,7 +519,7 @@ def _refine_flow(params: Dict, f1: jnp.ndarray, f2: jnp.ndarray, cnet: jnp.ndarr
         f2_pyramid = _build_f2_pyramid(f2)
         od_impl = "matmul" if corr_impl == "on_demand_matmul" else "gather"
         lookup = lambda coords: _lookup_on_demand(  # noqa: E731
-            f1, f2_pyramid, coords, od_impl)
+            f1, f2_pyramid, coords, od_impl, dtype=dtype)
 
     net = jnp.tanh(cnet[..., :HIDDEN_DIM]).astype(dtype)
     inp = _relu(cnet[..., HIDDEN_DIM:]).astype(dtype)
