@@ -16,7 +16,8 @@ Same methodology as the other profilers (tools/_bench_util). Stages:
 - extractor2x: both 6-level feature pyramids
 - corr_all:    the 6 cost volumes (level 6 no-warp + 5 warped-target volumes)
                on fixed features (no decoder chain)
-- warp_all:    the 5 Backward warps on fixed features/flows
+- warp_all_{gather,onehot}: the 4 decoder Backward warps (levels 5..2)
+               on fixed features/flows, per lowering
 - full:        pwc_forward (xla cost volume)
 
 Run: python tools/profile_pwc.py [batch] [side]
@@ -92,21 +93,23 @@ def main():
 
     time_fn("corr_all", corr_all, mk_corr)
 
-    # --- 5 warps on fixed features/flows ---
-    @jax.jit
-    def warp_all(*args):
-        outs = []
-        for i in range(0, len(args), 2):
-            outs.append(warp_backward(args[i], args[i + 1]))
-        return outs
-
+    # --- the 4 decoder warps (levels 5..2; level 6 has no prior flow)
+    #     on fixed features/flows, each warp lowering ---
     def mk_warp():
         out = []
         for level in (2, 3, 4, 5):
             out += [feats(level), flows(level)]
         return tuple(out)
 
-    time_fn("warp_all", warp_all, mk_warp)
+    for warp_impl in ("gather", "onehot"):
+        @jax.jit
+        def warp_all(*args, warp_impl=warp_impl):
+            outs = []
+            for i in range(0, len(args), 2):
+                outs.append(warp_backward(args[i], args[i + 1], warp_impl))
+            return outs
+
+        time_fn(f"warp_all_{warp_impl}", warp_all, mk_warp)
 
     # --- full forward ---
     @jax.jit

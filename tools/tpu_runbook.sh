@@ -25,4 +25,6 @@ run ondemand python tools/profile_on_demand.py
 # 4. I3D clips_per_batch knee at 224² (verdict item 5)
 run i3d_c8 python tools/profile_i3d.py 8 64
 run i3d_c16 python tools/profile_i3d.py 16 64
+# 5. PWC stage attribution incl. gather-vs-onehot warp microbench
+run pwc_stages python tools/profile_pwc.py 16 256
 echo "RUNBOOK COMPLETE $(date -u)" | tee -a "$L/runbook.log"
