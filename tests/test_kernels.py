@@ -129,16 +129,23 @@ def test_i3d_bf16_tap_path_close_to_fp32():
 def test_resolve_corr_impl_auto_switches_on_volume_size(monkeypatch):
     from video_features_tpu.models.raft import resolve_corr_impl
 
+    # ambient escape-hatch exports must not leak into these assertions
+    monkeypatch.delenv("VFT_RAFT_ON_DEMAND_IMPL", raising=False)
     # 16 pairs at 256²: pyramid 16·(32·32)²·4 B·1.328 ≈ 89 MB → volume
     assert resolve_corr_impl("auto", 16, 256, 256) == "volume"
-    # 16 pairs at 1080p: 16·(135·240)²·4 B·1.328 ≈ 89 GB — several times HBM
+    # 16 pairs at 1080p: 16·(135·240)²·4 B·1.328 ≈ 89 GB — several times
+    # HBM; the gather-free matmul remat is the big-frame default, with the
+    # env escape hatch back to the gather formulation
+    assert resolve_corr_impl("auto", 16, 1080, 1920) == "on_demand_matmul"
+    monkeypatch.setenv("VFT_RAFT_ON_DEMAND_IMPL", "gather")
     assert resolve_corr_impl("auto", 16, 1080, 1920) == "on_demand"
+    monkeypatch.delenv("VFT_RAFT_ON_DEMAND_IMPL")
     # explicit choices pass through untouched
-    for impl in ("volume", "volume_gather", "on_demand"):
+    for impl in ("volume", "volume_gather", "on_demand", "on_demand_matmul"):
         assert resolve_corr_impl(impl, 16, 1080, 1920) == impl
     # bf16 halves the volume: a geometry just past the fp32 budget fits
     monkeypatch.setenv("VFT_RAFT_VOLUME_BUDGET", str(16 * (32 * 32) ** 2 * 4))
-    assert resolve_corr_impl("auto", 16, 256, 256) == "on_demand"  # 1.33x > 1x
+    assert resolve_corr_impl("auto", 16, 256, 256) == "on_demand_matmul"
     # mesh-sharded step: the budget is per DEVICE — 8 devices hold 2 pairs
     # each, so the same global batch fits (advisor round-3 finding)
     assert resolve_corr_impl("auto", 16, 256, 256, n_devices=8) == "volume"

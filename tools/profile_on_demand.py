@@ -6,8 +6,9 @@ query chunk's slice of the correlation volume per iteration on the MXU, zero
 gathers — models/raft.py::_lookup_on_demand impl='matmul').
 
 Default geometry 1080×1920 (one pair): 1/8-res grid 135×240 → the pyramid
-would need ~5.6 GB fp32, past the 4 GiB auto budget — exactly the regime
-``auto`` resolves to on_demand (resolve_corr_impl docstring). ``--small``
+would need ~5.6 GB fp32, past the 4 GiB auto budget — the regime where
+``auto`` leaves the volume path (it now resolves to on_demand_matmul;
+``VFT_RAFT_ON_DEMAND_IMPL=gather`` reverts — resolve_corr_impl docstring). ``--small``
 swaps in 512² (volume fits; all three impls comparable) for a cross-check
 against the volume path's numbers.
 

@@ -71,11 +71,11 @@ def build_parser() -> argparse.ArgumentParser:
                         default="auto",
                         help="RAFT correlation: auto (default) = materialized "
                              "pyramid with MXU matmul lookup unless the volume "
-                             "would outgrow HBM for the frame size, then the "
-                             "on-demand alt_cuda_corr equivalent (O(H*W) memory); "
-                             "or force volume / volume_gather / on_demand / "
-                             "on_demand_matmul (gather-free on-demand: remat "
-                             "the volume slice per iteration on the MXU)")
+                             "would outgrow HBM for the frame size, then "
+                             "on_demand_matmul (the gather-free alt_cuda_corr "
+                             "equivalent: remat the volume slice per iteration "
+                             "on the MXU, O(H*W) memory); or force volume / "
+                             "volume_gather / on_demand / on_demand_matmul")
     parser.add_argument("--pwc_corr", choices=["auto", "xla", "pallas"],
                         default="auto",
                         help="PWC cost-volume implementation: auto picks the "

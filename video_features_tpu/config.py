@@ -70,9 +70,10 @@ class ExtractionConfig:
     flow_dtype: str = "float32"
     # RAFT correlation: "auto" (default) materializes the all-pairs pyramid
     # (reference default path, same numerics) unless the volume would outgrow
-    # HBM for the frame geometry, then switches to "on_demand" (the
-    # alt_cuda_corr equivalent — O(H·W·D) memory instead of O((H·W)²));
-    # explicit "volume"/"volume_gather"/"on_demand" force a path.
+    # HBM for the frame geometry, then switches to "on_demand_matmul" (the
+    # gather-free alt_cuda_corr equivalent — O(H·W·D) memory, per-iteration
+    # MXU volume remat; VFT_RAFT_ON_DEMAND_IMPL=gather reverts); explicit
+    # "volume"/"volume_gather"/"on_demand"/"on_demand_matmul" force a path.
     raft_corr: str = "auto"
     # PWC cost volume: "auto" (default) picks the Pallas tile kernel where its
     # VMEM gates admit the shape (measured faster at production shapes,

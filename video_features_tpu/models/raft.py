@@ -51,10 +51,13 @@ _VOLUME_HBM_BUDGET = 4 * 1024**3
 def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
                       dtype=jnp.float32, n_devices: int = 1) -> str:
     """Resolve ``auto`` per frame geometry: the reference-default materialized
-    volume while it fits, the O(H·W·D) on-demand path beyond. In fp32 the two
-    paths are numerically identical (tested); under ``dtype=bfloat16`` the
-    volume path stores a bf16 pyramid while on-demand keeps fp32 correlation
-    values, so the switchover changes rounding within the bf16 drift budget.
+    volume while it fits, the O(H·W·D) on-demand MATMUL remat beyond
+    (gather-free; ``VFT_RAFT_ON_DEMAND_IMPL=gather`` reverts to the gather
+    formulation). In fp32 the paths agree to reduction-order ulps (~3e-3 px
+    through 20 iterations, tools/profile_on_demand.py); under
+    ``dtype=bfloat16`` the volume path stores a bf16 pyramid while the remat
+    rounds the einsum inputs — the same one-bf16-rounding drift class,
+    bounded in tests/test_flow_bf16.py.
 
     The pyramid holds ``n_pairs · (h/8·w/8)² · Σ4⁻ˡ`` correlation values
     (corr.py:12-27 geometry); e.g. 16 pairs at 1080p → ~89 GB fp32, several
@@ -76,7 +79,21 @@ def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
     itemsize = 2 if dtype == jnp.bfloat16 else 4
     per_device_pairs = max(1, -(-n_pairs // max(n_devices, 1)))
     vol_bytes = per_device_pairs * q * q * itemsize * (1 + 1 / 4 + 1 / 16 + 1 / 64)
-    return "volume" if vol_bytes <= budget else "on_demand"
+    if vol_bytes <= budget:
+        return "volume"
+    # past the budget, the gather-free matmul remat is the default: the
+    # gather on-demand path is the measured 40× cliff (scalar-unit bound),
+    # while the remat is the same one-hot/MXU trade that won 15.5× on the
+    # volume lookup — measured 3.2-3.6× faster even on CPU where gathers
+    # are cheap (tools/profile_on_demand.py; TPU confirmation via the same
+    # tool — VFT_RAFT_ON_DEMAND_IMPL=gather reverts if it ever loses there)
+    choice = os.environ.get("VFT_RAFT_ON_DEMAND_IMPL", "matmul")
+    if choice not in ("gather", "matmul"):
+        # fail loudly like VFT_RAFT_VOLUME_BUDGET does — a typo'd revert
+        # that silently stayed on matmul would mislabel a measurement
+        raise ValueError(
+            f"VFT_RAFT_ON_DEMAND_IMPL must be gather|matmul, got {choice!r}")
+    return "on_demand" if choice == "gather" else "on_demand_matmul"
 
 # (name, cin, cout, kernel, stride, pad) for plain convs; residual layers described
 # structurally in _encoder below.
@@ -429,7 +446,10 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     correlations per iteration from pooled f2 features (the ``alt_cuda_corr``
     equivalent — O(H·W·D) memory instead of O((H·W)²) for frames whose volume
     outgrows HBM, see :func:`_build_f2_pyramid`; gather-bound, so it trades
-    ~40× speed for that memory ceiling).
+    ~40× speed for that memory ceiling); ``on_demand_matmul`` keeps the
+    memory ceiling but remats the volume slice per iteration on the MXU
+    instead of gathering (``auto``'s big-frame choice — see
+    :func:`_lookup_on_demand`).
 
     ``taps``: debug-only dict filled with per-stage activations (fnet/cnet/corr/
     per-iteration flow) for the layer-diff parity harness (tools/layer_diff.py);
