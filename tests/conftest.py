@@ -63,11 +63,13 @@ def rng():
 _FAST_MODULES = {
     "test_config_cli",
     "test_edge_cases",
+    "test_fault_barrier_lint",
     "test_filelist_output",
     "test_fps_resampler",
     "test_golden_pipeline",
     "test_mirror_independence",
     "test_parallel",
+    "test_reliability",
     "test_resample",
     "test_resnet_extractor",
     "test_spatial",

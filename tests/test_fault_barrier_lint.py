@@ -1,0 +1,62 @@
+"""Tier-1 guard: the fault-barrier lint keeps the error taxonomy from eroding."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import lint_fault_barrier  # noqa: E402
+
+
+def test_repo_is_clean():
+    findings, counts = lint_fault_barrier.scan(REPO)
+    assert findings == []
+    assert sum(counts.values()) == sum(lint_fault_barrier.ALLOWED.values())
+
+
+def test_main_exit_code_clean(capsys):
+    assert lint_fault_barrier.main([REPO]) == 0
+    assert "no strays" in capsys.readouterr().out
+
+
+@pytest.fixture
+def fake_repo(tmp_path):
+    pkg = tmp_path / "video_features_tpu"
+    pkg.mkdir()
+    return tmp_path, pkg
+
+
+def test_detects_unmarked_broad_except(fake_repo):
+    root, pkg = fake_repo
+    (pkg / "sneaky.py").write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n")
+    findings, _ = lint_fault_barrier.scan(str(root))
+    assert any("without a 'fault-barrier:'" in f for f in findings)
+
+
+def test_detects_undeclared_file_even_with_marker(fake_repo):
+    root, pkg = fake_repo
+    (pkg / "undeclared.py").write_text(
+        "try:\n    pass\nexcept Exception:  # fault-barrier: sounds legit\n    pass\n")
+    findings, _ = lint_fault_barrier.scan(str(root))
+    assert any("no declared barriers" in f for f in findings)
+
+
+def test_detects_bare_except_and_base_exception(fake_repo):
+    root, pkg = fake_repo
+    (pkg / "bare.py").write_text(
+        "try:\n    pass\nexcept:\n    pass\n"
+        "try:\n    pass\nexcept BaseException:\n    pass\n")
+    findings, _ = lint_fault_barrier.scan(str(root))
+    assert len([f for f in findings if "without a 'fault-barrier:'" in f]) == 2
+
+
+def test_clean_fake_repo_passes(fake_repo):
+    root, pkg = fake_repo
+    (pkg / "fine.py").write_text(
+        "try:\n    pass\nexcept ValueError:\n    pass\n")
+    findings, counts = lint_fault_barrier.scan(str(root))
+    assert findings == [] and counts == {}

@@ -1,0 +1,236 @@
+"""End-to-end fault-injection suite: the run loop under injected failures.
+
+Drives the full per-video barrier — retry/backoff, watchdog, failure
+manifest, circuit breaker, decode-pool crash propagation, kill-mid-write —
+through the ``VFT_FAULTS`` harness (``reliability/faults.py``) against a
+lightweight frame-stream extractor, plus one real ``run.main`` job for the
+exit-code contract.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.extractors.base import Extractor
+from video_features_tpu.io.output import load_done_set
+from video_features_tpu.reliability import (
+    CircuitBreakerTripped,
+    failed_manifest_path,
+    load_failures,
+    reset_faults,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+def _write_video(path, frames=4, size=(32, 24)):
+    import cv2
+
+    w = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), 10.0, size)
+    rng = np.random.default_rng(0)
+    for _ in range(frames):
+        w.write(rng.integers(0, 256, (size[1], size[0], 3), dtype=np.uint8))
+    w.release()
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """Six decodable tiny videos vid0..vid5."""
+    d = tmp_path_factory.mktemp("corpus")
+    return [_write_video(d / f"vid{i}.mp4") for i in range(6)]
+
+
+class StreamCounter(Extractor):
+    """Minimal frame-stream consumer: exercises the run loop, not a model."""
+
+    uses_frame_stream = True
+
+    def extract(self, video_path):
+        meta, frames = self._open_video(video_path)
+        total, n = 0.0, 0
+        for rgb, _pos in frames:
+            total += float(rgb.mean())
+            n += 1
+        return {"feat": np.asarray([total, float(n)], np.float32)}
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("retries", 2)
+    kw.setdefault("retry_backoff", 0.01)
+    return ExtractionConfig(
+        feature_type="resnet50", on_extraction="save_numpy", num_devices=1,
+        output_path=str(tmp_path / "o"), tmp_path=str(tmp_path / "t"), **kw)
+
+
+def test_transient_failure_retried_with_backoff_and_succeeds(
+        tmp_path, corpus, monkeypatch, capsys):
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_transient:vid2:1")
+    ex = StreamCounter(_cfg(tmp_path))
+    assert ex.run(corpus) == len(corpus)
+    assert load_failures(ex.output_dir) == {}
+    assert len(load_done_set(ex.output_dir)) == len(corpus)
+    out = capsys.readouterr().out
+    assert "attempt 1 failed" in out and "retrying in" in out
+
+
+def test_permanent_failures_recorded_and_job_completes(tmp_path, corpus):
+    """~30% of the corpus is corrupt; the job finishes with correct counts and
+    every failure lands classified in the failure manifest."""
+    bad = [str(tmp_path / f"bad{i}.mp4") for i in range(3)]
+    for p in bad:
+        with open(p, "wb") as f:
+            f.write(b"\x13garbage" * 512)
+    paths = corpus[:1] + bad[:1] + corpus[1:4] + bad[1:] + corpus[4:]
+    ex = StreamCounter(_cfg(tmp_path, retries=1))
+    assert ex.run(paths) == len(corpus)
+    failures = load_failures(ex.output_dir)
+    assert set(failures) == {os.path.abspath(p) for p in bad}
+    for rec in failures.values():
+        assert rec["error_class"] == "DecodeError"
+        assert rec["transient"] is False
+        assert rec["attempts"] == 1  # permanent: no retry burned
+    assert len(load_done_set(ex.output_dir)) == len(corpus)
+
+
+def test_watchdog_cancels_injected_hang(tmp_path, corpus, monkeypatch):
+    monkeypatch.setenv("VFT_FAULTS", "extract:hang(30):vid1:1")
+    ex = StreamCounter(_cfg(tmp_path, video_timeout=0.5, retries=1))
+    t0 = time.monotonic()
+    assert ex.run(corpus) == len(corpus) - 1
+    assert time.monotonic() - t0 < 15.0  # the 30s hang did not run out
+    failures = load_failures(ex.output_dir)
+    (rec,) = failures.values()
+    assert rec["video"] == os.path.abspath(corpus[1])
+    assert rec["error_class"] == "VideoTimeoutError"
+    assert rec["attempts"] == 1  # timeouts are permanent: not retried
+
+
+def test_watchdog_abandoned_attempt_never_marks_done(tmp_path, corpus, monkeypatch):
+    """An attempt that outlives its timeout and then completes must discard
+    its results — not write features + a done record for a video the run
+    already counted as failed (regression: double-bookkeeping both manifests)."""
+    monkeypatch.setenv("VFT_FAULTS", "extract:hang(1.5):vid0:1")
+    ex = StreamCounter(_cfg(tmp_path, video_timeout=0.3, retries=0))
+    assert ex.run(corpus[:1]) == 0
+    time.sleep(2.5)  # let the abandoned thread wake up past the hang
+    assert load_done_set(ex.output_dir) == set()
+    assert not any(n.endswith(".npy") for n in os.listdir(ex.output_dir))
+    (rec,) = load_failures(ex.output_dir).values()
+    assert rec["error_class"] == "VideoTimeoutError"
+
+
+def test_retry_failed_reprocesses_exactly_the_failed_set(
+        tmp_path, corpus, monkeypatch):
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:vid3")
+    ex = StreamCounter(_cfg(tmp_path))
+    assert ex.run(corpus) == len(corpus) - 1
+    assert set(load_failures(ex.output_dir)) == {os.path.abspath(corpus[3])}
+
+    monkeypatch.delenv("VFT_FAULTS")
+    reset_faults()
+    failed = sorted(load_failures(ex.output_dir))
+    assert failed == [os.path.abspath(corpus[3])]
+    assert ex.run(failed) == 1
+    # the success pruned its record; the empty manifest file is removed
+    assert load_failures(ex.output_dir) == {}
+    assert not os.path.exists(failed_manifest_path(ex.output_dir))
+    assert len(load_done_set(ex.output_dir)) == len(corpus)
+
+
+def test_circuit_breaker_aborts_on_max_failures(tmp_path, corpus, monkeypatch):
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent")
+    ex = StreamCounter(_cfg(tmp_path, max_failures=1))
+    with pytest.raises(CircuitBreakerTripped, match="max_failures"):
+        ex.run(corpus)
+    # the two tolerated-then-tripping failures are on record for --retry_failed
+    assert len(load_failures(ex.output_dir)) == 2
+
+
+def test_decode_pool_worker_crash_surfaces_classified(tmp_path, corpus, monkeypatch):
+    """A worker crashing inside the pool (not in open_video) must surface as a
+    classified error at the barrier and not deadlock the remaining videos."""
+    monkeypatch.setenv("VFT_FAULTS", "pool_worker:raise:vid4")
+    ex = StreamCounter(_cfg(tmp_path, decode_workers=2, retries=1))
+    t0 = time.monotonic()
+    assert ex.run(corpus) == len(corpus) - 1
+    assert time.monotonic() - t0 < 30.0  # no deadlock
+    failures = load_failures(ex.output_dir)
+    assert set(failures) == {os.path.abspath(corpus[4])}
+    assert failures[os.path.abspath(corpus[4])]["error_class"] == "DecodeError"
+
+
+def test_kill_mid_write_leaves_no_partial_npy(tmp_path):
+    """SIGKILL between tmp-write and rename: the final .npy must not exist,
+    resume must not count the video done, and a rerun completes the write."""
+    out = str(tmp_path / "out")
+    code = (
+        "import os\n"
+        "os.environ['VFT_FAULTS'] = 'save:kill'\n"
+        "import numpy as np\n"
+        "from video_features_tpu.io.output import action_on_extraction\n"
+        f"action_on_extraction({{'feat': np.arange(100000)}}, 'vidX.mp4', {out!r}, 'save_numpy')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 137, proc.stderr
+    final = os.path.join(out, "vidX_feat.npy")
+    assert not os.path.exists(final)  # never a truncated readable .npy
+    assert load_done_set(out) == set()  # resume will redo this video
+
+    action = (
+        "import numpy as np\n"
+        "from video_features_tpu.io.output import action_on_extraction\n"
+        f"action_on_extraction({{'feat': np.arange(100000)}}, 'vidX.mp4', {out!r}, 'save_numpy')\n"
+    )
+    env.pop("VFT_FAULTS", None)
+    proc = subprocess.run([sys.executable, "-c", action], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    np.testing.assert_array_equal(np.load(final), np.arange(100000))
+
+
+def test_run_main_exit_codes_and_counts(tmp_path, corpus, monkeypatch, capsys):
+    """Real CLI job (ResNet-50, random weights): a fault-injected run where
+    2/6 videos fail exits 1 with correct manifests; --retry_failed with the
+    faults cleared reprocesses exactly those 2 and exits 0."""
+    from video_features_tpu.run import main as run_main
+
+    monkeypatch.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    monkeypatch.setenv("VFT_FAULTS",
+                       "extract:raise_permanent:vid1;extract:raise_permanent:vid4")
+    out, tmp = str(tmp_path / "o"), str(tmp_path / "t")
+    argv = ["--feature_type", "resnet50", "--video_paths", *corpus,
+            "--on_extraction", "save_numpy", "--output_path", out,
+            "--tmp_path", tmp, "--num_devices", "1", "--batch_size", "4",
+            "--retries", "1", "--retry_backoff", "0.01"]
+    assert run_main(argv) == 1
+    feat_dir = os.path.join(out, "resnet50")
+    assert len(load_done_set(feat_dir)) == 4
+    assert set(load_failures(feat_dir)) == {
+        os.path.abspath(corpus[1]), os.path.abspath(corpus[4])}
+    assert "2 video(s) failed" in capsys.readouterr().out
+
+    monkeypatch.delenv("VFT_FAULTS")
+    reset_faults()
+    assert run_main(argv + ["--retry_failed"]) == 0
+    assert len(load_done_set(feat_dir)) == 6
+    assert load_failures(feat_dir) == {}
+    # every saved output is loadable — no partial files anywhere
+    for name in os.listdir(feat_dir):
+        if name.endswith(".npy"):
+            np.load(os.path.join(feat_dir, name))
+    assert not any(n.endswith(".tmp") for n in os.listdir(feat_dir))

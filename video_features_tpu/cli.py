@@ -123,6 +123,26 @@ def build_parser() -> argparse.ArgumentParser:
                         help="apply the AudioSet PCA-whiten + uint8 quantize "
                              "postprocessor to VGGish embeddings (vendored params; "
                              "the reference loads but never applies it)")
+    # Reliability flags (docs/reliability.md)
+    parser.add_argument("--retries", type=int, default=2,
+                        help="re-attempts after a TRANSIENT per-video failure "
+                             "(FfmpegError/DeviceError/OutputError); permanent "
+                             "classes (DecodeError, watchdog timeouts) never retry")
+    parser.add_argument("--retry_backoff", type=float, default=0.5,
+                        help="first retry delay in seconds; doubles per retry "
+                             "(capped at 30s)")
+    parser.add_argument("--video_timeout", type=float, default=None,
+                        help="per-video watchdog: cancel any video whose attempt "
+                             "exceeds this many seconds and record it as "
+                             "VideoTimeoutError (default: no timeout)")
+    parser.add_argument("--max_failures", type=int, default=None,
+                        help="circuit breaker: abort the run (exit code 2) once "
+                             "more than this many videos have terminally failed "
+                             "(0 = abort on first failure; default: never)")
+    parser.add_argument("--retry_failed", action="store_true", default=False,
+                        help="reprocess exactly the videos in the failure manifest "
+                             "(<output>/<feature_type>/.failed_manifest.jsonl) "
+                             "instead of --video_paths/--file_with_video_paths")
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")

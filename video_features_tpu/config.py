@@ -120,6 +120,27 @@ class ExtractionConfig:
     # quantization for |flow| ≤ 32; "bfloat16" at ≤0.16 px for |flow| ≈ 20.
     # "float32" (default) is bit-parity.
     transfer_dtype: str = "float32"
+    # --- reliability knobs (docs/reliability.md) ---
+    # Bounded retry for transient per-video failures (FfmpegError, DeviceError,
+    # OutputError): number of RE-attempts after the first failure. Permanent
+    # classes (DecodeError, VideoTimeoutError) never retry.
+    retries: int = 2
+    # First backoff delay in seconds; doubles per retry (capped at 30 s).
+    retry_backoff: float = 0.5
+    # Per-video watchdog: cancel and classify any video whose attempt exceeds
+    # this many seconds (a wedged cv2 read or ffmpeg child must not stall the
+    # fleet). None (default) = no timeout.
+    video_timeout: Optional[float] = None
+    # Circuit breaker: abort the run (exit code 2) once MORE THAN this many
+    # videos have terminally failed — a job drowning in errors usually has a
+    # systemic cause (bad mount, dead device) and burning the rest of the
+    # corpus hides it. None = never abort. 0 = abort on the first failure.
+    max_failures: Optional[int] = None
+    # Reprocess exactly the videos recorded in the failure manifest
+    # (.failed_manifest.jsonl beside the done-manifest) instead of the given
+    # video list; retried entries are pruned and re-append only if they fail
+    # again.
+    retry_failed: bool = False
     # I3D geometry: smaller-edge resize target and center-crop size. The
     # reference hard-codes 256/224 (extract_i3d.py:25 + transforms); these stay
     # the parity defaults. Overriding shrinks the SAME jitted two-stream
@@ -168,6 +189,14 @@ class ExtractionConfig:
             raise ValueError("matmul_precision must be default|high|highest")
         if self.decode_workers < 1:
             raise ValueError("decode_workers must be >= 1")
+        if self.retries < 0:
+            raise ValueError("retries must be >= 0")
+        if self.retry_backoff < 0:
+            raise ValueError("retry_backoff must be >= 0")
+        if self.video_timeout is not None and self.video_timeout <= 0:
+            raise ValueError("video_timeout must be > 0 seconds (omit to disable)")
+        if self.max_failures is not None and self.max_failures < 0:
+            raise ValueError("max_failures must be >= 0 (0 = abort on first failure)")
         if self.flow_pair_chunk is not None and self.flow_pair_chunk < 0:
             raise ValueError("flow_pair_chunk must be >= 0 (0 = never chunk)")
         if self.use_ffmpeg not in ("auto", "always", "never"):

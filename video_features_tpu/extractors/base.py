@@ -3,13 +3,18 @@
 Factors the loop every reference extractor re-implements (``extract_*.py``): iterate
 videos with a per-video fault barrier (log & continue — ``extract_i3d.py:107-117``),
 hand each finished feature dict to the output action, track progress. Adds what the
-reference lacks: a done-manifest for resume and device-count awareness.
+reference lacks: a done-manifest for resume, device-count awareness, and the
+reliability layer (:mod:`..reliability`) — classified errors, bounded retry with
+backoff for transient failures, a per-video watchdog, a failure manifest, and a
+``--max_failures`` circuit breaker.
 """
 
 from __future__ import annotations
 
 import abc
 import os
+import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -26,6 +31,18 @@ from ..io.output import (
 from ..io.video import open_video
 from ..parallel import MeshRunner
 from ..parallel.pipeline import DecodePrefetcher
+from ..reliability import (
+    CircuitBreakerTripped,
+    RetryPolicy,
+    VideoTimeoutError,
+    classify,
+    failed_manifest_path,
+    fault_point,
+    prune_failures,
+    record_failure,
+    retry_call,
+    run_with_timeout,
+)
 from ..utils.metrics import StageClock, maybe_profiler, metrics_enabled
 
 
@@ -53,6 +70,8 @@ class Extractor(abc.ABC):
         self.clock: Optional[StageClock] = None
         # cross-video decode pool; created by run() when --decode_workers > 1
         self._decode_pool: Optional[DecodePrefetcher] = None
+        # videos that succeeded in the current run() (failure-manifest pruning)
+        self._succeeded: List[str] = []
 
     # --- per-model API ---
 
@@ -74,6 +93,8 @@ class Extractor(abc.ABC):
             keep_tmp_files=self.cfg.keep_tmp_files,
             use_ffmpeg=self.cfg.use_ffmpeg,
             transform=self._host_transform,
+            retries=self.cfg.retries,
+            retry_backoff=self.cfg.retry_backoff,
         )
 
     def _open_video(self, video_path: str):
@@ -122,6 +143,9 @@ class Extractor(abc.ABC):
         """Process all videos with the per-video fault barrier; returns #succeeded.
 
         ``progress``: optional callable invoked after each video (done, total).
+        Terminal failures are classified (:func:`..reliability.classify`),
+        recorded in the failure manifest, and survived — unless they exceed
+        ``--max_failures``, which raises :class:`CircuitBreakerTripped`.
         """
         paths = list(video_paths) if video_paths is not None else self.video_list()
         done = load_done_set(self.output_dir) if self.cfg.resume else set()
@@ -132,20 +156,105 @@ class Extractor(abc.ABC):
         elif workers > 1:
             print(f"--decode_workers ignored: {self.feature_type} does not "
                   "consume the frame stream (whole-video / audio decode)")
+        self._succeeded: List[str] = []  # pruned from the failure manifest at exit
         try:
             return self._run_loop(paths, done, with_metrics, progress)
         finally:
             # KeyboardInterrupt / a raising progress callback must not leak
-            # decode workers busy-waiting on full queues
+            # decode workers busy-waiting on full queues — shut the pool down
+            # FIRST so a raising manifest prune can't skip it
             if self._decode_pool is not None:
                 self._decode_pool.shutdown()
                 self._decode_pool = None
+            # even on KeyboardInterrupt / circuit breaker: converge the failure
+            # manifest for everything that DID succeed this run
+            self._prune_succeeded(self._succeeded)
+
+    def _process_one(self, path: str, cancelled: Optional[threading.Event] = None) -> None:
+        """One attempt at one video: extract → output action → mark done.
+
+        ``cancelled`` is set by the watchdog on timeout: an abandoned attempt
+        that later wakes up (typically over a partial frame stream — releasing
+        the decode-pool slot turns the remaining frames into a clean-looking
+        EOF) must discard its results, not write truncated features and a
+        done-manifest record for a video the run already counted as failed.
+        """
+
+        def check_cancelled(stage: str) -> None:
+            if cancelled is not None and cancelled.is_set():
+                raise VideoTimeoutError(
+                    f"{path}: attempt was cancelled by the watchdog; {stage}")
+
+        fault_point("extract", path)
+        feats_dict = self.extract(path)
+        check_cancelled("discarding possibly-partial features")
+        action_on_extraction(feats_dict, path, self.output_dir, self.cfg.on_extraction)
+        if self.cfg.on_extraction == "save_numpy":
+            check_cancelled("features written but NOT marked done")
+            mark_done(self.output_dir, path, feats_dict.keys())
+
+    def _attempt_with_retries(self, path: str) -> None:
+        """Run one video under the watchdog + transient-retry policy.
+
+        Each attempt is watchdog-bounded individually (``--video_timeout``
+        limits an *attempt*, not the retry budget). Between attempts the
+        decode-pool slot is released so the retry decodes fresh — the stale
+        prefetched stream may itself be the failure.
+        """
+
+        def on_retry(exc, attempt, delay):
+            err_class, _ = classify(exc)
+            print(f"[{err_class}] attempt {attempt} failed for {path}: {exc}; "
+                  f"retrying in {delay:.2g}s")
+            if self._decode_pool is not None:
+                self._decode_pool.release(path)
+
+        def attempt_once():
+            cancel = threading.Event()
+            return run_with_timeout(
+                lambda: self._process_one(path, cancel),
+                self.cfg.video_timeout, path, on_timeout=cancel.set,
+            )
+
+        retry_call(
+            attempt_once,
+            RetryPolicy(attempts=self.cfg.retries + 1,
+                        base_delay=self.cfg.retry_backoff),
+            on_retry=on_retry,
+        )
+
+    def _prune_succeeded(self, succeeded: List[str]) -> None:
+        """Drop stale failure records for videos that just succeeded.
+
+        One batched rewrite (not one per success — a mostly-successful retry
+        pass over F failures would otherwise cost O(F²) manifest I/O), in the
+        run's ``finally`` so KeyboardInterrupt and the circuit breaker still
+        converge the manifest. Single-host only: the read-modify-replace
+        rewrite would race other hosts' ``record_failure`` appends; on
+        multi-host runs stale records simply remain until a single-host
+        ``--retry_failed`` pass clears them.
+        """
+        import jax
+
+        if not succeeded or jax.process_count() > 1:
+            return
+        if not os.path.exists(failed_manifest_path(self.output_dir)):
+            return
+        try:
+            prune_failures(self.output_dir, succeeded)
+        except (OSError, ValueError) as e:
+            # ValueError covers UnicodeDecodeError from a byte-corrupted
+            # manifest; raised from run()'s finally it would mask the
+            # in-flight exception, so warn instead
+            print(f"warning: could not prune {len(succeeded)} failure "
+                  f"record(s): {e}", file=sys.stderr)
 
     def _run_loop(self, paths, done, with_metrics, progress) -> int:
         todo = [p for p in paths if os.path.abspath(p) not in done]
         workers = self.cfg.decode_workers
         ok = 0
         extracted = 0  # excludes resume-skipped videos (throughput honesty)
+        failures = 0
         cursor = 0  # decode-window cursor over `todo`
         t_run = time.perf_counter()
         with maybe_profiler(self.cfg.profile_dir):
@@ -163,21 +272,42 @@ class Extractor(abc.ABC):
                 self.clock = StageClock() if with_metrics else None
                 t0 = time.perf_counter()
                 try:
-                    feats_dict = self.extract(path)
-                    action_on_extraction(
-                        feats_dict, path, self.output_dir, self.cfg.on_extraction
-                    )
-                    if self.cfg.on_extraction == "save_numpy":
-                        mark_done(self.output_dir, path, feats_dict.keys())
+                    self._attempt_with_retries(path)
                     ok += 1
                     extracted += 1
+                    self._succeeded.append(path)
                     if self.clock is not None:
                         print(self.clock.report(path, time.perf_counter() - t0))
                 except KeyboardInterrupt:
                     raise
-                except Exception as e:  # noqa: BLE001 — per-video fault barrier
+                except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point
+                    failures += 1
+                    err_class, transient = classify(e)
+                    attempts = getattr(e, "attempts", 1)
+                    # best-effort: the manifest write hitting the same dying
+                    # disk as the failure itself must not escape the barrier
+                    try:
+                        record = record_failure(self.output_dir, path, e, attempts)
+                        digest = record["traceback_digest"]
+                    except OSError as rec_err:
+                        digest = "unrecorded"
+                        print(f"warning: could not record failure for {path}: "
+                              f"{rec_err}", file=sys.stderr)
                     print(e)
-                    print(f"Extraction failed at: {path} with error (↑). Continuing extraction")
+                    print(f"Extraction failed at: {path} with error (↑). "
+                          f"Continuing extraction "
+                          f"[{err_class}, transient={transient}, "
+                          f"attempts={attempts}, digest={digest}]")
+                    if (self.cfg.max_failures is not None
+                            and failures > self.cfg.max_failures):
+                        raise CircuitBreakerTripped(
+                            f"{failures} videos failed (> --max_failures "
+                            f"{self.cfg.max_failures}); aborting — a failure "
+                            "rate this high usually has a systemic cause. "
+                            "Failures so far are recorded in the failure "
+                            "manifest; fix the cause and rerun with "
+                            "--retry_failed."
+                        ) from e
                 finally:
                     self.clock = None
                     if self._decode_pool is not None:

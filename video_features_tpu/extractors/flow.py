@@ -164,7 +164,7 @@ class ExtractFlow(Extractor):
         if self._async_copy_ok:
             try:
                 flow.copy_to_host_async()
-            except Exception as e:  # noqa: BLE001 — see below
+            except Exception as e:  # noqa: BLE001 — fault-barrier: optional-optimization probe (see below)
                 # backend lacks async host copy (AttributeError /
                 # NotImplementedError / backend-specific UNIMPLEMENTED
                 # runtime errors) — probe once, disarm, and say WHICH error
@@ -272,7 +272,7 @@ class ExtractFlow(Extractor):
                     cv2.imshow("frame + flow", bgr)
                     cv2.waitKey(1)
                     continue
-                except Exception:
+                except Exception:  # fault-barrier: headless-host probe; falls back to PNG dump
                     has_display = False
             viz_dir = self.output_dir + "_viz"
             os.makedirs(viz_dir, exist_ok=True)

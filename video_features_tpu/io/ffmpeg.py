@@ -5,6 +5,11 @@ Mirrors ``which_ffmpeg`` / ``reencode_video_with_diff_fps`` / ``extract_wav_from
 binary is absent, fps changes fall back to index-based frame sampling in the decoder
 (:mod:`video_features_tpu.io.video`), and mp4 audio extraction raises a clear error
 (wav inputs still work via scipy).
+
+Subprocess failures raise :class:`~..reliability.FfmpegError` (transient — dead
+children are usually environmental: OOM killer, tmp-dir pressure) instead of the
+reference's fire-and-forget ``subprocess.call`` whose nonzero exits were silently
+ignored and surfaced later as empty decode streams.
 """
 
 from __future__ import annotations
@@ -14,7 +19,9 @@ import os
 import pathlib
 import shutil
 import subprocess
-from typing import Tuple
+from typing import Sequence, Tuple
+
+from ..reliability import FfmpegError, fault_point
 
 
 def which_ffmpeg() -> str:
@@ -24,6 +31,45 @@ def which_ffmpeg() -> str:
 
 def have_ffmpeg() -> bool:
     return which_ffmpeg() != ""
+
+
+# stderr markers for failures caused by the INPUT BYTES, which no amount of
+# retrying will change — these demote the (class-transient) FfmpegError to
+# permanent so the retry budget is spent on environmental deaths only
+_PERMANENT_STDERR_MARKERS = (
+    "Invalid data found when processing input",
+    "moov atom not found",
+    "does not contain any stream",
+)
+
+
+def _run_checked(cmd: Sequence[str], src_path: str, out_path: str) -> None:
+    """Run one ffmpeg command; classify every way it can fail.
+
+    The reference's ``subprocess.call`` discards the return code, so a crashed
+    or killed ffmpeg surfaced only as a missing/empty output file decoded into
+    zero frames downstream. Here: nonzero exit, a spawn failure, and a
+    missing/empty output all raise :class:`FfmpegError` naming the source.
+    Input-caused exits (corrupt container, no audio stream) are tagged
+    permanent; environmental deaths (signals, spawn failures) stay transient.
+    """
+    fault_point("ffmpeg", src_path)
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+    except OSError as e:
+        raise FfmpegError(f"could not spawn ffmpeg for {src_path}: {e}") from e
+    if proc.returncode != 0:
+        stderr = (proc.stderr or "").strip()
+        tail = stderr.splitlines()[-3:]
+        err = FfmpegError(
+            f"ffmpeg exited {proc.returncode} for {src_path}"
+            + (": " + " | ".join(tail) if tail else "")
+        )
+        if proc.returncode > 0 and any(m in stderr for m in _PERMANENT_STDERR_MARKERS):
+            err.transient = False  # the bytes will not improve; do not retry
+        raise err
+    if not os.path.exists(out_path) or os.path.getsize(out_path) == 0:
+        raise FfmpegError(f"ffmpeg exited 0 but produced no output at {out_path}")
 
 
 def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: int) -> str:
@@ -48,10 +94,10 @@ def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps:
     new_path = os.path.join(
         tmp_path, f"{pathlib.Path(video_path).stem}_{tag}_new_fps.mp4")
     cmd = [
-        which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
+        which_ffmpeg(), "-hide_banner", "-loglevel", "error", "-y",
         "-i", video_path, "-filter:v", f"fps=fps={extraction_fps}", new_path,
     ]
-    subprocess.call(cmd)
+    _run_checked(cmd, video_path, new_path)
     return new_path
 
 
@@ -71,12 +117,22 @@ def extract_wav_from_mp4(video_path: str, tmp_path: str) -> Tuple[str, str]:
     stem = pathlib.Path(video_path).stem
     aac_path = os.path.join(tmp_path, f"{stem}.aac")
     wav_path = os.path.join(tmp_path, f"{stem}.wav")
-    subprocess.call([
-        which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
+    _run_checked([
+        which_ffmpeg(), "-hide_banner", "-loglevel", "error", "-y",
         "-i", video_path, "-acodec", "copy", aac_path,
-    ])
-    subprocess.call([
-        which_ffmpeg(), "-hide_banner", "-loglevel", "panic", "-y",
-        "-i", aac_path, wav_path,
-    ])
+    ], video_path, aac_path)
+    try:
+        _run_checked([
+            which_ffmpeg(), "-hide_banner", "-loglevel", "error", "-y",
+            "-i", aac_path, wav_path,
+        ], aac_path, wav_path)
+    except FfmpegError:
+        # the caller's cleanup never sees (wav, aac) when this raises — don't
+        # leak one orphaned .aac per terminally-failed video into tmp_path
+        try:
+            if os.path.exists(aac_path):
+                os.remove(aac_path)
+        except OSError:
+            pass
+        raise
     return wav_path, aac_path

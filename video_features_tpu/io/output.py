@@ -4,6 +4,10 @@ Reproduces ``action_on_extraction`` (``utils/utils.py:45-74``) including the
 ``<stem>_<key>.npy`` naming and the per-feature-type output subdirectory the reference
 extractors join before calling it (e.g. ``extract_i3d.py:78``). Adds a done-manifest so
 interrupted jobs can resume (the reference reruns everything — SURVEY.md §5).
+
+Writes are atomic (tmp + ``os.replace``): a SIGKILL mid-save must never leave a
+truncated ``.npy`` that a later ``--resume`` counts as done. Filesystem failures
+raise :class:`~..reliability.OutputError` (transient — disk/NFS pressure clears).
 """
 
 from __future__ import annotations
@@ -11,9 +15,13 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import sys
 from typing import Dict, Mapping
 
 import numpy as np
+
+from ..reliability import OutputError, fault_point
+from ..reliability.manifest import read_jsonl
 
 MANIFEST_NAME = ".done_manifest.jsonl"
 
@@ -21,6 +29,31 @@ MANIFEST_NAME = ".done_manifest.jsonl"
 def feature_output_dir(output_path: str, feature_type: str) -> str:
     """Features land in ``<output_path>/<feature_type>/`` (reference extract_*.py)."""
     return os.path.join(output_path, feature_type)
+
+
+def _atomic_save(fpath: str, value: np.ndarray) -> None:
+    """Write ``value`` to ``fpath`` via tmp + rename; never a truncated final file.
+
+    ``np.save`` appends ``.npy`` to *names*, not file objects, so the tmp file
+    is written through an explicit handle. A crash between write and rename
+    leaves only ``<file>.npy.tmp`` — invisible to loaders and to ``--resume``.
+    """
+    tmp = fpath + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.save(f, value)
+        fault_point("save", fpath)
+        os.replace(tmp, fpath)
+    except OSError as e:
+        raise OutputError(f"failed to write {fpath}: {e}") from e
+    finally:
+        # on success the replace consumed tmp; on ANY failure (including an
+        # injected fault) remove it — only a hard kill may leave one behind
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
 
 
 def action_on_extraction(
@@ -45,12 +78,15 @@ def action_on_extraction(
             print(f"max: {value.max():.8f}; mean: {value.mean():.8f}; min: {value.min():.8f}")
             print()
         elif on_extraction == "save_numpy":
-            os.makedirs(output_path, exist_ok=True)
+            try:
+                os.makedirs(output_path, exist_ok=True)
+            except OSError as e:
+                raise OutputError(f"cannot create output dir {output_path}: {e}") from e
             fname = f"{pathlib.Path(video_path).stem}_{key}.npy"
             fpath = os.path.join(output_path, fname)
             if value.ndim > 0 and len(value) == 0:
                 print(f"Warning: the value is empty for {key} @ {fpath}")
-            np.save(fpath, value)
+            _atomic_save(fpath, value)
             saved[key] = fpath
         else:
             raise NotImplementedError(f"on_extraction: {on_extraction} is not implemented")
@@ -63,24 +99,34 @@ def manifest_path(output_path: str) -> str:
 
 def mark_done(output_path: str, video_path: str, keys) -> None:
     """Append a completion record for ``video_path`` to the done-manifest."""
-    os.makedirs(output_path, exist_ok=True)
     record = {"video": os.path.abspath(video_path), "keys": sorted(keys)}
-    with open(manifest_path(output_path), "a") as f:
-        f.write(json.dumps(record) + "\n")
+    try:
+        os.makedirs(output_path, exist_ok=True)
+        with open(manifest_path(output_path), "a") as f:
+            f.write(json.dumps(record) + "\n")
+    except OSError as e:
+        raise OutputError(f"cannot append to done-manifest in {output_path}: {e}") from e
 
 
 def load_done_set(output_path: str) -> set:
-    """Absolute video paths already completed according to the manifest."""
+    """Absolute video paths already completed according to the manifest.
+
+    Corrupt/undecodable lines (a crash mid-append, manual edits) are counted
+    and warned about, not silently skipped: every dropped line is a video that
+    ``--resume`` will re-extract, and the operator should know why.
+    """
     done = set()
     path = manifest_path(output_path)
-    if os.path.exists(path):
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    done.add(json.loads(line)["video"])
-                except (json.JSONDecodeError, KeyError):
-                    continue
+    records, corrupt = read_jsonl(path)
+    for record in records:
+        if "video" in record:
+            done.add(record["video"])
+        else:
+            corrupt += 1
+    if corrupt:
+        print(
+            f"warning: ignored {corrupt} corrupt line(s) in {path}; "
+            "the affected videos will be re-extracted on --resume",
+            file=sys.stderr,
+        )
     return done

@@ -14,6 +14,7 @@ batches to the device pipeline.
 from __future__ import annotations
 
 import os
+import sys
 from dataclasses import dataclass
 from typing import Callable, Iterator, Optional, Tuple
 
@@ -21,6 +22,7 @@ import cv2
 import numpy as np
 
 from . import ffmpeg as ffmpeg_io
+from ..reliability import DecodeError, FfmpegError, RetryPolicy, fault_point, retry_call
 
 
 @dataclass
@@ -33,15 +35,30 @@ class VideoMeta:
 
 
 def probe_video(video_path: str) -> VideoMeta:
+    """Container metadata, or a classified :class:`DecodeError` for corrupt input.
+
+    cv2 "opens" many garbage files and reports ``fps=0, frame_count=0``;
+    returning that meta poisons every downstream fps computation silently, so
+    unopenable and degenerate containers raise instead.
+    """
+    fault_point("probe", video_path)
     cap = cv2.VideoCapture(video_path)
     try:
-        return VideoMeta(
+        if not cap.isOpened():
+            raise DecodeError(f"{video_path}: cannot open container (corrupt or unsupported)")
+        meta = VideoMeta(
             path=video_path,
             fps=cap.get(cv2.CAP_PROP_FPS),
             frame_count=int(cap.get(cv2.CAP_PROP_FRAME_COUNT)),
             width=int(cap.get(cv2.CAP_PROP_FRAME_WIDTH)),
             height=int(cap.get(cv2.CAP_PROP_FRAME_HEIGHT)),
         )
+        if meta.fps <= 0 and meta.frame_count <= 0:
+            raise DecodeError(
+                f"{video_path}: container reports fps={meta.fps} and "
+                f"frame_count={meta.frame_count} (corrupt header)"
+            )
+        return meta
     finally:
         cap.release()
 
@@ -107,6 +124,8 @@ def open_video(
     keep_tmp_files: bool = False,
     use_ffmpeg: str = "auto",
     transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    retries: int = 2,
+    retry_backoff: float = 0.5,
 ) -> Tuple[VideoMeta, Iterator[Tuple[np.ndarray, float]]]:
     """Open a video; return (meta, iterator of (rgb_uint8_frame, pos_msec)).
 
@@ -114,18 +133,43 @@ def open_video(
     available (``use_ffmpeg='auto'``/'always'; exact reference parity) or via the
     native sampler ('never' or no ffmpeg binary). ``transform``, if given, is applied
     to each RGB frame on the host (e.g. PIL-bilinear resize).
+
+    Failed ffmpeg re-encodes (transient: :class:`FfmpegError`) are retried
+    ``retries`` times with exponential backoff starting at ``retry_backoff``
+    seconds; if every attempt fails under ``use_ffmpeg='auto'``, the native
+    sampler takes over (graceful degradation — the video survives at the cost
+    of sampler-vs-reencode parity) while 'always' propagates the error.
+    Unopenable/corrupt containers raise a classified :class:`DecodeError`.
     """
     if use_ffmpeg not in ("auto", "always", "never"):
         raise ValueError(f"use_ffmpeg must be 'auto'|'always'|'never', got {use_ffmpeg!r}")
     if not os.path.exists(video_path):
         raise FileNotFoundError(f"video does not exist: {video_path}")
+    fault_point("decode", video_path)
     reencoded = None
     if extraction_fps is not None and use_ffmpeg != "never":
         if ffmpeg_io.have_ffmpeg():
-            reencoded = ffmpeg_io.reencode_video_with_diff_fps(
-                video_path, tmp_path, extraction_fps
-            )
-            video_path = reencoded
+            try:
+                reencoded = retry_call(
+                    lambda: ffmpeg_io.reencode_video_with_diff_fps(
+                        video_path, tmp_path, extraction_fps
+                    ),
+                    RetryPolicy(attempts=retries + 1, base_delay=retry_backoff),
+                )
+                video_path = reencoded
+            except FfmpegError as e:
+                if use_ffmpeg == "always":
+                    # the bounded retry above already owns this transient
+                    # class; mark the escaping instance permanent so the
+                    # per-video retry layer does not multiply the attempts
+                    # (retries+1)^2-fold
+                    e.transient = False
+                    raise
+                print(
+                    f"warning: ffmpeg re-encode failed for {video_path} "
+                    f"({e}); falling back to the native fps sampler",
+                    file=sys.stderr,
+                )
         elif use_ffmpeg == "always":
             raise RuntimeError(
                 "use_ffmpeg='always' requested for fps resampling but ffmpeg is not "
@@ -133,13 +177,16 @@ def open_video(
             )
 
     cap = cv2.VideoCapture(video_path)
+    if not cap.isOpened():
+        cap.release()
+        raise DecodeError(f"{video_path}: cannot open container (corrupt or unsupported)")
     src_fps = cap.get(cv2.CAP_PROP_FPS)
     src_count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
     native_resample = extraction_fps is not None and reencoded is None
     if native_resample:
         if src_fps <= 0:
             cap.release()
-            raise ValueError(
+            raise DecodeError(
                 f"{video_path}: container reports fps={src_fps}; cannot resample to "
                 f"{extraction_fps} fps without a source rate"
             )

@@ -22,6 +22,8 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from ..reliability import fault_point
+
 
 def maybe_initialize_distributed() -> bool:
     """Join a multi-host JAX job when one is configured; no-op otherwise.
@@ -40,7 +42,7 @@ def maybe_initialize_distributed() -> bool:
         from jax._src import distributed  # noqa: PLC2701 — no public probe exists
 
         already = distributed.global_state.client is not None
-    except Exception:
+    except Exception:  # fault-barrier: private-API probe; absence means "not initialized"
         already = False
     if already:
         return jax.process_count() > 1
@@ -145,6 +147,10 @@ class DecodePrefetcher:
             try:
                 if stopped():
                     return
+                # crash-injection seam: a worker dying HERE (not inside
+                # open_fn) must still surface a classified error at consume
+                # time instead of deadlocking the drain — tests prove it
+                fault_point("pool_worker", path)
                 meta, frames = self._open(path)
                 slot["meta"] = meta
                 slot["ready"].set()
@@ -157,7 +163,7 @@ class DecodePrefetcher:
                             continue
                     if stopped():
                         return
-            except Exception as e:  # noqa: BLE001 — re-raised at consume time
+            except Exception as e:  # noqa: BLE001 — fault-barrier: re-raised classified at consume time
                 slot["err"] = e
             finally:
                 slot["ready"].set()

@@ -1,0 +1,55 @@
+"""Fault-tolerance subsystem: error taxonomy, retry, watchdog, failure manifest.
+
+The reference's only robustness feature is a per-video ``except Exception: print``
+barrier (``extract_i3d.py:107-117``). At fleet scale the failure modes the systems
+papers treat as first-class (corrupt containers, wedged subprocesses, partial
+writes, device faults — PAPERS.md: "TensorFlow: A system for large-scale machine
+learning", "Podracer architectures") need classification, bounded retry,
+cancellation, and a durable record. This package provides the pieces; the io
+layer raises the taxonomy, :mod:`..extractors.base` runs the barrier, and
+``reliability/faults.py`` injects failures so tests can prove the loop end to end.
+"""
+
+from .errors import (
+    CircuitBreakerTripped,
+    DecodeError,
+    DeviceError,
+    ExtractionError,
+    FfmpegError,
+    OutputError,
+    VideoTimeoutError,
+    classify,
+    traceback_digest,
+)
+from .faults import fault_point, reset_faults
+from .manifest import (
+    FAILED_MANIFEST_NAME,
+    failed_manifest_path,
+    load_failures,
+    prune_failures,
+    record_failure,
+)
+from .retry import RetryPolicy, retry_call
+from .watchdog import run_with_timeout
+
+__all__ = [
+    "CircuitBreakerTripped",
+    "DecodeError",
+    "DeviceError",
+    "ExtractionError",
+    "FfmpegError",
+    "OutputError",
+    "VideoTimeoutError",
+    "classify",
+    "traceback_digest",
+    "fault_point",
+    "reset_faults",
+    "FAILED_MANIFEST_NAME",
+    "failed_manifest_path",
+    "load_failures",
+    "prune_failures",
+    "record_failure",
+    "RetryPolicy",
+    "retry_call",
+    "run_with_timeout",
+]

@@ -1,0 +1,143 @@
+"""Fault-injection harness: deterministic failures at declared pipeline sites.
+
+The fault-tolerance claims in this package are only real if tests can crash
+the pipeline on demand. Production code calls :func:`fault_point(site, key)`
+at the seams where real failures occur; the hook is inert unless the
+``VFT_FAULTS`` environment variable names that site. Tests (and chaos drills
+on a staging fleet) set the variable; production never does, so the hook cost
+is one env read per video, not per frame.
+
+Spec grammar — rules separated by ``;``, fields by ``:``::
+
+    VFT_FAULTS = "site:action[:match[:count]] [; ...]"
+
+- ``site`` — one of the declared sites below.
+- ``action`` — ``raise`` (site's default taxonomy error), ``raise_transient``
+  / ``raise_permanent`` (force the retry tag), ``hang(SECONDS)`` (sleep,
+  simulating a wedged decode — pair with ``--video_timeout``), or ``kill``
+  (``os._exit(137)``, simulating SIGKILL mid-operation).
+- ``match`` — substring of the key (usually the video path); empty matches all.
+- ``count`` — how many times the rule fires before going inert; empty =
+  unlimited. ``ffmpeg:raise::1`` fails exactly the first ffmpeg call — the
+  canonical transient-then-success retry test.
+
+Declared sites: ``probe`` and ``decode`` (io/video.py), ``ffmpeg``
+(io/ffmpeg.py), ``save`` (io/output.py, between tmp-write and atomic rename),
+``extract`` (extractors/base.py, wraps the whole per-video attempt),
+``pool_worker`` (parallel/pipeline.py decode-worker body).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from typing import List, Optional
+
+from .errors import (
+    DecodeError,
+    DeviceError,
+    ExtractionError,
+    FfmpegError,
+    OutputError,
+)
+
+ENV_VAR = "VFT_FAULTS"
+
+_SITE_ERRORS = {
+    "probe": DecodeError,
+    "decode": DecodeError,
+    "pool_worker": DecodeError,
+    "ffmpeg": FfmpegError,
+    "extract": DeviceError,
+    "device": DeviceError,
+    "save": OutputError,
+}
+
+
+class _Rule:
+    __slots__ = ("site", "action", "arg", "match", "remaining")
+
+    def __init__(self, site: str, action: str, arg: float, match: str, count: Optional[int]):
+        self.site = site
+        self.action = action
+        self.arg = arg
+        self.match = match
+        self.remaining = count  # None = unlimited
+
+
+def _parse(spec: str) -> List[_Rule]:
+    rules = []
+    for chunk in spec.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = chunk.split(":")
+        if len(fields) < 2:
+            raise ValueError(f"{ENV_VAR} rule needs site:action, got {chunk!r}")
+        site, action = fields[0].strip(), fields[1].strip()
+        match = fields[2].strip() if len(fields) > 2 else ""
+        count = int(fields[3]) if len(fields) > 3 and fields[3].strip() else None
+        arg = 0.0
+        m = re.fullmatch(r"hang\(([\d.]+)\)", action)
+        if m:
+            action, arg = "hang", float(m.group(1))
+        if action not in ("raise", "raise_transient", "raise_permanent", "hang", "kill"):
+            raise ValueError(f"unknown fault action {action!r} in {chunk!r}")
+        rules.append(_Rule(site, action, arg, match, count))
+    return rules
+
+
+_lock = threading.Lock()
+_cached_spec: Optional[str] = None
+_rules: List[_Rule] = []
+
+
+def reset_faults() -> None:
+    """Drop the parsed-rule cache (tests flip ``VFT_FAULTS`` between cases)."""
+    global _cached_spec, _rules
+    with _lock:
+        _cached_spec = None
+        _rules = []
+
+
+def _injected_error(site: str, force_transient: Optional[bool]) -> ExtractionError:
+    base = _SITE_ERRORS.get(site, DeviceError)
+    if force_transient is None or force_transient == base.transient:
+        return base(f"injected fault at site {site!r}")
+    cls = type(f"Injected{base.__name__}", (base,), {"transient": force_transient})
+    return cls(f"injected fault at site {site!r} (forced transient={force_transient})")
+
+
+def fault_point(site: str, key: str = "") -> None:
+    """Production hook: crash/hang/die here iff ``VFT_FAULTS`` says so."""
+    spec = os.environ.get(ENV_VAR, "")
+    if not spec:
+        return
+    global _cached_spec, _rules
+    with _lock:
+        if spec != _cached_spec:
+            _rules = _parse(spec)
+            _cached_spec = spec
+        fire = None
+        for rule in _rules:
+            if rule.site != site or rule.match not in key:
+                continue
+            if rule.remaining is not None:
+                if rule.remaining <= 0:
+                    continue
+                rule.remaining -= 1
+            fire = rule
+            break
+    if fire is None:
+        return
+    if fire.action == "hang":
+        deadline = time.monotonic() + (fire.arg or 3600.0)
+        while time.monotonic() < deadline:
+            time.sleep(0.05)
+        return
+    if fire.action == "kill":
+        os._exit(137)
+    force = {"raise": None, "raise_transient": True, "raise_permanent": False}[fire.action]
+    raise _injected_error(site, force)

@@ -1,0 +1,121 @@
+"""Failure manifest: a durable, append-only record of what failed and why.
+
+Lives beside the done-manifest (``io/output.py``) as
+``.failed_manifest.jsonl`` in the per-feature output directory. Each line is
+one terminal failure: video path, taxonomy class, transient tag, attempt
+count, message, and a traceback digest that groups identical failure sites
+across a corpus. ``--retry_failed`` consumes it (:func:`take_failed_videos`);
+operators grep it to answer "what died, and was it our fault?" without
+scraping logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+from .errors import classify, traceback_digest
+
+FAILED_MANIFEST_NAME = ".failed_manifest.jsonl"
+
+
+def read_jsonl(path: str) -> Tuple[List[dict], int]:
+    """Tolerantly read a JSONL manifest: (dict records, corrupt line count).
+
+    Shared by the done- and failure-manifests: blank lines are ignored,
+    undecodable or non-dict lines are counted (callers warn — a dropped line
+    is a video whose state the operator no longer knows).
+    """
+    records: List[dict] = []
+    corrupt = 0
+    if not os.path.exists(path):
+        return records, corrupt
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                corrupt += 1
+                continue
+            if not isinstance(rec, dict):
+                corrupt += 1
+                continue
+            records.append(rec)
+    return records, corrupt
+
+
+def failed_manifest_path(output_dir: str) -> str:
+    return os.path.join(output_dir, FAILED_MANIFEST_NAME)
+
+
+def record_failure(
+    output_dir: str, video_path: str, exc: BaseException, attempts: int = 1
+) -> dict:
+    """Append one failure record; returns the record written."""
+    error_class, transient = classify(exc)
+    record = {
+        "video": os.path.abspath(video_path),
+        "error_class": error_class,
+        "transient": transient,
+        "attempts": int(attempts),
+        "message": str(exc)[:500],
+        "traceback_digest": traceback_digest(exc),
+    }
+    os.makedirs(output_dir, exist_ok=True)
+    with open(failed_manifest_path(output_dir), "a") as f:
+        f.write(json.dumps(record) + "\n")
+    return record
+
+
+def load_failures(output_dir: str) -> Dict[str, dict]:
+    """{abs video path: last failure record}; warns on corrupt lines.
+
+    The last record per video wins — a video that failed, was retried by a
+    later run, and failed again appears once with its latest classification.
+    """
+    out: Dict[str, dict] = {}
+    path = failed_manifest_path(output_dir)
+    records, corrupt = read_jsonl(path)
+    for record in records:
+        if "video" in record:
+            out[record["video"]] = record
+        else:
+            corrupt += 1
+    if corrupt:
+        print(
+            f"warning: ignored {corrupt} corrupt line(s) in {path}; "
+            "those failures are invisible to --retry_failed",
+            file=sys.stderr,
+        )
+    return out
+
+
+def prune_failures(output_dir: str, videos) -> None:
+    """Rewrite the manifest without records for ``videos`` (abs or raw paths).
+
+    The run loop prunes the videos that *succeeded*, in one batch at run exit
+    (never the whole manifest up front): an interrupted ``--retry_failed`` run
+    then loses no records — the not-yet-attempted tail stays in the manifest
+    for the next run. Single-host only (callers guard): this read-modify-
+    replace would race concurrent ``record_failure`` appends from other hosts.
+    If the last record vanishes the manifest file is removed entirely, so "no
+    failure manifest" stays synonymous with "nothing failed".
+    """
+    path = failed_manifest_path(output_dir)
+    if not os.path.exists(path):
+        return
+    drop = {os.path.abspath(v) for v in videos}
+    keep = [r for v, r in load_failures(output_dir).items() if v not in drop]
+    if not keep:
+        os.remove(path)
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        for record in keep:
+            f.write(json.dumps(record) + "\n")
+    os.replace(tmp, path)

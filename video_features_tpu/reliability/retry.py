@@ -1,0 +1,86 @@
+"""Bounded retry with exponential backoff, keyed on the error taxonomy.
+
+Only transient failures (see :mod:`.errors`) are retried: re-decoding a corrupt
+container burns a full decode pass to learn nothing, while re-running a video
+whose ffmpeg child was OOM-killed usually succeeds. Delays grow exponentially
+and are capped; the sleep function is injectable so tests assert the schedule
+without waiting it out.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator, Optional, TypeVar
+
+from .errors import classify
+
+T = TypeVar("T")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """``attempts`` is the total try count (1 = no retries)."""
+
+    attempts: int = 3
+    base_delay: float = 0.5
+    max_delay: float = 30.0
+    multiplier: float = 2.0
+
+    def __post_init__(self):
+        if self.attempts < 1:
+            raise ValueError("attempts must be >= 1")
+        if self.base_delay < 0 or self.max_delay < 0:
+            raise ValueError("delays must be >= 0")
+
+    def delays(self) -> Iterator[float]:
+        """Backoff before retry k (k = 1..attempts-1): min(base·mult^(k-1), max)."""
+        d = self.base_delay
+        for _ in range(self.attempts - 1):
+            yield min(d, self.max_delay)
+            d *= self.multiplier
+
+
+def retry_call(
+    fn: Callable[[], T],
+    policy: RetryPolicy,
+    *,
+    should_retry: Optional[Callable[[BaseException], bool]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[BaseException, int, float], None]] = None,
+) -> T:
+    """Call ``fn`` under ``policy``; retry transient failures with backoff.
+
+    ``should_retry`` defaults to the taxonomy's transient tag
+    (:func:`.errors.classify`). ``on_retry(exc, attempt, delay)`` fires before
+    each backoff sleep — the extraction loop uses it to release decode-pool
+    state so a retry decodes fresh. The final exception is re-raised with an
+    ``attempts`` attribute so the failure manifest records the try count.
+    """
+    if should_retry is None:
+        should_retry = lambda exc: classify(exc)[1]  # noqa: E731
+    delays = list(policy.delays())
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except KeyboardInterrupt:
+            raise
+        except Exception as exc:  # noqa: BLE001 — fault-barrier: classified & re-raised
+            retryable = attempt <= len(delays) and should_retry(exc)
+            if not retryable:
+                # only if unset: a nested retry layer (e.g. the ffmpeg
+                # re-encode retry inside open_video) already counted the real
+                # attempts — the outer layer must not overwrite them with 1
+                if not hasattr(exc, "attempts"):
+                    try:
+                        exc.attempts = attempt
+                    except Exception:  # noqa: BLE001 — fault-barrier: exotic __slots__ exceptions
+                        pass
+                raise
+            delay = delays[attempt - 1]
+            if on_retry is not None:
+                on_retry(exc, attempt, delay)
+            if delay > 0:
+                sleep(delay)

@@ -1,0 +1,68 @@
+"""Per-video watchdog: bound the wall-clock any single video may consume.
+
+A wedged cv2 read or ffmpeg child otherwise stalls the whole host — the fleet
+failure mode the large-scale systems papers design out first. Python cannot
+kill an arbitrary thread, so the watchdog runs the attempt in a daemon worker
+and *abandons* it on timeout: the caller gets a classified
+:class:`~.errors.VideoTimeoutError` immediately and moves to the next video,
+while the wedged thread either unwinds when its decode-pool slot is released
+(the run loop's per-video ``finally`` cancels the stream) or is reclaimed at
+process exit. That trade — a leaked thread vs. a hung fleet — is the right one
+for batch extraction.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, TypeVar
+
+from .errors import VideoTimeoutError
+
+T = TypeVar("T")
+
+
+def run_with_timeout(
+    fn: Callable[[], T],
+    timeout: Optional[float],
+    label: str = "",
+    on_timeout: Optional[Callable[[], None]] = None,
+) -> T:
+    """Run ``fn()`` with a wall-clock bound; ``timeout=None`` runs inline.
+
+    On timeout raises :class:`VideoTimeoutError` (permanent: a video that
+    wedges once usually wedges again). Exceptions from ``fn`` propagate with
+    their original traceback; KeyboardInterrupt in the waiting thread
+    propagates immediately (the abandoned worker is a daemon).
+
+    ``on_timeout`` fires before the raise — the extraction loop passes a
+    cancellation event's ``set`` so the abandoned attempt, should it wake up
+    later over a partial frame stream, discards its results instead of writing
+    truncated features behind a done-manifest record.
+    """
+    if timeout is None:
+        return fn()
+    if timeout <= 0:
+        raise ValueError("timeout must be > 0 (or None to disable)")
+
+    result: list = []
+    error: list = []
+
+    def target() -> None:
+        try:
+            result.append(fn())
+        except BaseException as exc:  # noqa: BLE001 — fault-barrier: handed to the waiter
+            error.append(exc)
+
+    t = threading.Thread(target=target, daemon=True, name=f"watchdog:{label}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        if on_timeout is not None:
+            on_timeout()
+        raise VideoTimeoutError(
+            f"{label or 'video'}: exceeded --video_timeout {timeout:g}s; "
+            "cancelled (decode stream released, worker thread abandoned)"
+        )
+    if error:
+        raise error[0]
+    return result[0]

@@ -8,6 +8,12 @@ Drop-in surface of the reference ``main.py`` (same flags), invoked via the repo'
 Videos are embarrassingly parallel: the list is processed by the extractor, whose
 device step is jit-compiled for the local TPU mesh; multi-host jobs shard the list
 round-robin per host (``--num_devices`` governs the local mesh size).
+
+Exit codes: 0 — every video succeeded; 1 — some videos failed (classified records
+in the failure manifest, reprocess with ``--retry_failed``) or the video list was
+empty; 2 — the run aborted before processing the full list: the ``--max_failures``
+circuit breaker tripped, or the invocation was invalid (``--retry_failed`` on a
+multi-host job; argparse flag errors also exit 2). See docs/reliability.md.
 """
 
 import os
@@ -30,7 +36,7 @@ def _honor_jax_platforms_env() -> None:
 
         try:
             jax.config.update("jax_platforms", want)
-        except Exception as e:
+        except Exception as e:  # fault-barrier: best-effort env shim; warn and continue
             print(f"warning: could not apply JAX_PLATFORMS={want}: {e}", file=sys.stderr)
 
 
@@ -48,7 +54,28 @@ def main(argv=None) -> int:
         print(f"multi-host job: process {jax.process_index()}/{jax.process_count()}")
 
     extractor = get_extractor(cfg)
-    paths = extractor.video_list()
+    if cfg.retry_failed:
+        # reprocess exactly the failure-manifest set; each video's record is
+        # pruned as it succeeds (an interrupted retry run loses no records)
+        # and re-appends only if it fails again. Single-host only — enforced,
+        # because concurrent per-host manifest rewrites would clobber records.
+        import jax
+
+        from video_features_tpu.reliability import load_failures
+
+        if jax.process_count() > 1:
+            print("--retry_failed is single-host only: concurrent hosts "
+                  "rewriting the shared failure manifest would lose records. "
+                  "Run it from one host (it processes only the failed set).",
+                  file=sys.stderr)
+            return 2
+        paths = sorted(load_failures(extractor.output_dir))
+        if not paths:
+            print("No failed videos to retry (failure manifest is empty).")
+            return 0
+        print(f"--retry_failed: reprocessing {len(paths)} video(s) from the failure manifest")
+    else:
+        paths = extractor.video_list()
     if not paths:
         print("No videos to process.")
         return 1
@@ -65,11 +92,20 @@ def main(argv=None) -> int:
     def progress(done, total):
         print(f"\r[{done}/{total}] videos processed", end="", flush=True)
 
-    ok = extractor.run(paths, progress=progress)
+    from video_features_tpu.reliability import CircuitBreakerTripped, failed_manifest_path
+
+    try:
+        ok = extractor.run(paths, progress=progress)
+    except CircuitBreakerTripped as e:
+        print()
+        print(f"aborted: {e}")
+        return 2
     print()
     failed = len(paths) - ok
     if failed:
-        print(f"{failed} video(s) failed (see log above)")
+        print(f"{failed} video(s) failed; classified records in "
+              f"{failed_manifest_path(extractor.output_dir)} "
+              "(rerun with --retry_failed after fixing the cause)")
     return 0 if failed == 0 else 1
 
 
