@@ -177,10 +177,46 @@ def _backend_or_none(retries: int, wait_sec: float,
     each attempt PROBES in a subprocess under a hard timeout (the kill is
     the bound jax's own init doesn't offer); only after a probe succeeds is
     the backend initialized in-process (the tunnel is then known up, so the
-    real init is seconds). Returns the platform string, or None once the
-    retry budget is spent — the caller then emits a structured stale record
-    instead of a traceback.
+    real init is seconds). The in-process init runs under the SAME
+    wall-clock watchdog (ADVICE r5): a tunnel drop in the probe→init window
+    otherwise re-created the unbounded ~50 min hang — the init happens on a
+    daemon thread and an overrun counts as a failed attempt (the wedged
+    thread is abandoned; process exit reclaims it). Returns the platform
+    string, or None once the retry budget is spent — the caller then emits
+    a structured stale record instead of a traceback.
     """
+    import threading
+
+    def _init_in_process() -> tuple[str | None, str, bool]:
+        """(backend, error, wedged) — jax.default_backend() bounded by
+        probe_timeout. ``wedged``: the init thread is still alive past the
+        deadline — it holds jax's internal backend-init lock, so EVERY later
+        in-process attempt would block behind it; the caller must give up
+        (emit the stale record) rather than burn the retry budget on
+        attempts that can no longer succeed in this process."""
+        box: dict = {}
+
+        def target():
+            try:
+                import jax
+
+                # same redirect the probe subprocess applied
+                _apply_platform_redirect()
+                box["backend"] = jax.default_backend()
+            except Exception as e:  # noqa: BLE001
+                box["err"] = f"{type(e).__name__}: {e}"
+
+        t = threading.Thread(target=target, daemon=True, name="bench-backend-init")
+        t.start()
+        t.join(probe_timeout)
+        if "backend" in box:
+            return box["backend"], "", False
+        if t.is_alive():
+            return None, (f"in-process init exceeded {probe_timeout:.0f}s "
+                          "after a successful probe (tunnel dropped between "
+                          "probe and init?); the wedged thread poisons any "
+                          "further in-process init"), True
+        return None, box.get("err", "in-process init produced no backend"), False
     if probe_timeout is None:
         probe_timeout = float(os.environ.get("VFT_BENCH_INIT_TIMEOUT", 180))
     for attempt in range(retries):
@@ -198,14 +234,16 @@ def _backend_or_none(retries: int, wait_sec: float,
             out = subprocess.run(
                 [sys.executable, "-c", probe_code],
                 capture_output=True, text=True, timeout=probe_timeout)
-            for line in out.stdout.splitlines():
-                if line.startswith("BACKEND="):
-                    import jax
-
-                    # same redirect the probe subprocess applied
-                    _apply_platform_redirect()
-                    return jax.default_backend()  # probe ok → real init
-            why = (out.stderr.strip().splitlines() or ["no backend line"])[-1]
+            if any(line.startswith("BACKEND=") for line in out.stdout.splitlines()):
+                # probe ok → watchdogged real init
+                backend, why, wedged = _init_in_process()
+                if backend is not None:
+                    return backend
+                if wedged:
+                    _log(f"backend init wedged after a successful probe: {why}")
+                    return None  # retrying cannot recover in this process
+            else:
+                why = (out.stderr.strip().splitlines() or ["no backend line"])[-1]
         except subprocess.TimeoutExpired:
             why = f"probe timed out after {probe_timeout:.0f}s"
         except Exception as e:  # noqa: BLE001
@@ -231,27 +269,33 @@ def _read_baseline() -> tuple[float, dict]:
 
 
 def _emit_stale_record(reason: str) -> None:
-    """TPU unreachable: print a VALID headline line (rc=0) carrying the last
-    committed clean number, explicitly marked stale. A bench harness whose
-    record can be sunk by a tunnel outage has failed at its one job — the
-    driver's parser takes the last JSON line either way."""
-    stale_value = 0.0
+    """TPU unreachable: print a VALID headline line (rc=0) explicitly marked
+    stale. A bench harness whose record can be sunk by a tunnel outage has
+    failed at its one job — the driver's parser takes the last JSON line
+    either way. The headline ``value`` is 0.0 (ADVICE r5): this run measured
+    NOTHING, and a consumer that parses only value/vs_baseline must never
+    credit the current revision with an old revision's throughput. The last
+    committed clean number rides along as ``last_known_value``."""
+    last_known = 0.0
     stale_rev = None
     try:
         with open(os.path.join(REPO, "bench_details.json")) as f:
             prev = json.load(f)
-        stale_value = float(prev.get("i3d_rgb_float32", {}).get("value", 0.0))
+        last_known = float(prev.get("i3d_rgb_float32", {}).get("value", 0.0))
         stale_rev = prev.get("code_rev")
     except Exception:
         pass
     baseline, _ = _read_baseline()
     print(json.dumps({
         "metric": "i3d_rgb_clips_per_sec_per_chip",
-        "value": stale_value,
+        "value": 0.0,
         "unit": "clips/sec/chip (64-frame 224² stacks)",
-        "vs_baseline": round(stale_value / baseline, 3) if baseline else 0.0,
+        "vs_baseline": 0.0,
         "error": reason,
         "stale": True,
+        "last_known_value": last_known,
+        "last_known_vs_baseline": (round(last_known / baseline, 3)
+                                   if baseline else 0.0),
         "stale_source": "bench_details.json i3d_rgb_float32"
                         + (f" @ {stale_rev}" if stale_rev else ""),
     }), flush=True)
@@ -530,7 +574,27 @@ def main() -> None:
 
     # ---- I3D-flow composites: flow net + transform sandwich + I3D, one step ----
     # pwc is the reference's default flow for i3d (main.py:72-73); raft is the
-    # north-star accuracy path
+    # north-star accuracy path. On multi-chip hosts these flow-only 1-clip
+    # configs route through the encode-once FRAME-sharded step (PR 2): one
+    # clip's 64 source frames sharded across the mesh + the replicated final
+    # frame, instead of padding the clip axis to the mesh size.
+    def i3d_flow_step_and_inputs(ex):
+        if getattr(ex, "_flow_frame_sharded", False):
+            def mk(ex=ex):
+                stack = rng.integers(0, 256, (65, 256, 256, 3), dtype=np.uint8)
+                return (ex.i3d_params["flow"], ex.runner.put(stack[:-1]),
+                        ex.runner.put_replicated(stack[-1:]))
+
+            return ex._flow_step_sharded, mk
+
+        def mk(ex=ex):
+            return (ex.i3d_params["flow"],
+                    ex.runner.put(rng.integers(
+                        0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
+                        dtype=np.uint8)))
+
+        return ex._flow_step, mk
+
     if not on_cpu:
         for flow_type in ("pwc", "raft"):
             for flow_dtype in ("float32", "bfloat16"):
@@ -541,17 +605,11 @@ def main() -> None:
                     ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type=flow_type,
                                         stack_size=64, step_size=64, clips_per_batch=1,
                                         flow_dtype=flow_dtype))
-
-                    def mk_flow(ex=ex):
-                        return (ex.i3d_params["flow"],
-                                ex.runner.put(rng.integers(
-                                    0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
-                                    dtype=np.uint8)))
-
-                    timing = _time_step(ex._flow_step, mk_flow, iters=2)
+                    step, mk_flow = i3d_flow_step_and_inputs(ex)
+                    timing = _time_step(step, mk_flow, iters=2)
                     record(f"i3d_flow_{flow_type}_{flow_dtype}", timing,
                            ex.clips_per_batch, "clips/sec/chip",
-                           _flops_of(ex._flow_step, *mk_flow()))
+                           _flops_of(step, *mk_flow()))
 
         # performance-max two-stream flow step: BOTH the flow net and the I3D
         # conv stack in bf16 (the configs above keep the I3D side fp32)
@@ -560,20 +618,14 @@ def main() -> None:
                 ex = ExtractI3D(cfg("i3d", streams=("flow",), flow_type="pwc",
                                     stack_size=64, step_size=64, clips_per_batch=1,
                                     dtype="bfloat16", flow_dtype="bfloat16"))
-
-                def mk_flow_ab(ex=ex):
-                    return (ex.i3d_params["flow"],
-                            ex.runner.put(rng.integers(
-                                0, 256, (ex.clips_per_batch, 65, 256, 256, 3),
-                                dtype=np.uint8)))
-
-                timing = _time_step(ex._flow_step, mk_flow_ab, iters=2)
+                step, mk_flow_ab = i3d_flow_step_and_inputs(ex)
+                timing = _time_step(step, mk_flow_ab, iters=2)
                 record("i3d_flow_pwc_allbf16", timing, ex.clips_per_batch,
-                       "clips/sec/chip", _flops_of(ex._flow_step, *mk_flow_ab()))
+                       "clips/sec/chip", _flops_of(step, *mk_flow_ab()))
 
     # ---- RAFT dense flow: pairs/sec at 256² (20 GRU iterations) ---------------
     # production single-chip path: the shared-frame step (each frame encoded
-    # once); multi-device meshes use the pair-split step instead
+    # once); the multi-chip encode-once step has its own entry below
     pairs, side = (1, 128) if on_cpu else (16, 256)
     for flow_dtype in ("float32",) if on_cpu else ("float32", "bfloat16"):
         if over_budget(f"raft_pairs_{flow_dtype}"):
@@ -592,6 +644,27 @@ def main() -> None:
                                 repeats=_repeats(on_cpu))
             record(f"raft_pairs_{flow_dtype}", timing, ex.batch_size, "pairs/sec/chip",
                    _flops_of(ex._frames_step, *mk_pairs()), chips=ex.runner.num_devices)
+
+    # ---- RAFT dense flow, encode-once across the whole mesh (PR 2) ------------
+    # the production multi-device ExtractFlow path: B source frames sharded on
+    # the frame axis + the replicated final frame, pairs formed on device by
+    # halo exchange — vs the retired pair-split step that encoded every
+    # interior frame twice on meshes > 1 chip
+    if not on_cpu and n_chips > 1 and not over_budget("raft_pairs_float32_sharded"):
+        with guarded("raft_pairs_float32_sharded"):
+            ex = ExtractFlow(cfg("raft", batch_size=max(16, n_chips)))
+            _log(f"raft_pairs_float32_sharded: {ex.batch_size} pairs × {side}² "
+                 f"over {n_chips} chips")
+
+            def mk_sharded(ex=ex):
+                fr = rng.uniform(0, 255, (ex.batch_size + 1, side, side, 3)
+                                 ).astype(np.float32)
+                return (ex.params, ex.runner.put(fr[:-1]),
+                        ex.runner.put_replicated(fr[-1:]))
+
+            timing = _time_step(ex._frames_step_sharded, mk_sharded, iters=6)
+            record("raft_pairs_float32_sharded", timing, ex.batch_size,
+                   "pairs/sec/chip", _flops_of(ex._frames_step_sharded, *mk_sharded()))
 
     # ---- PWC dense flow: pairs/sec at 256², xla vs auto cost volume -----------
     # auto = the production default: tiled/single-block Pallas volume kernels

@@ -61,10 +61,12 @@ def rng():
 # added here. `slow` stays the parity/e2e layer; everything else is the
 # default `not slow` tier.
 _FAST_MODULES = {
+    "test_async_writer",
     "test_config_cli",
     "test_edge_cases",
     "test_fault_barrier_lint",
     "test_filelist_output",
+    "test_flow_sharded",
     "test_fps_resampler",
     "test_golden_pipeline",
     "test_mirror_independence",

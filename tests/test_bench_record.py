@@ -27,9 +27,13 @@ def test_stale_record_is_valid_parseable_headline(bench, capsys):
     rec = json.loads(line)
     assert rec["metric"] == "i3d_rgb_clips_per_sec_per_chip"
     assert rec["error"] == "tpu_unavailable" and rec["stale"] is True
-    # carries the last committed clean number (bench_details.json is in-repo)
-    assert rec["value"] > 0
-    assert rec["vs_baseline"] > 0
+    # an outage run measured NOTHING: value must be 0.0 so a parser that
+    # ignores the stale flag can never score the run as a measurement
+    # (ADVICE r5); the last committed clean number rides along separately
+    assert rec["value"] == 0.0
+    assert rec["vs_baseline"] == 0.0
+    assert rec["last_known_value"] > 0  # bench_details.json is in-repo
+    assert rec["last_known_vs_baseline"] > 0
 
 
 def test_read_baseline_matches_headline_math(bench):

@@ -165,6 +165,20 @@ def test_config_rejects_bad_i3d_geometry():
         ).validate()
 
 
+def test_config_warns_on_non_multiple_of_32_crop(capsys):
+    """112 is a common I3D crop: non-multiple-of-32 values >= 32 validate
+    with a warning instead of raising (ADVICE r5 — the multiple-of-32
+    tightening rejected previously-working configs)."""
+    from video_features_tpu.config import ExtractionConfig
+
+    ExtractionConfig(feature_type="i3d", i3d_crop_size=112).validate()
+    err = capsys.readouterr().err
+    assert "i3d_crop_size 112" in err and "multiple of 32" in err
+    # multiples of 32 stay silent
+    ExtractionConfig(feature_type="i3d", i3d_crop_size=224).validate()
+    assert "i3d_crop_size" not in capsys.readouterr().err
+
+
 def test_config_rejects_bad_flow_dtype_and_ffmpeg():
     import pytest
 

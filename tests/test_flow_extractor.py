@@ -193,7 +193,9 @@ def test_shape_bucket_bounds_compiles(tmp_path, monkeypatch):
     flow_b = ex._run_pairs(rng.uniform(0, 255, (3, 48, 34, 3)).astype(np.float32))
     assert flow_a.shape == (2, 2, 40, 56)
     assert flow_b.shape == (2, 2, 48, 34)
-    assert ex._step._cache_size() == 1  # both geometries hit the 64x64 bucket
+    # both geometries hit the 64x64 bucket → ONE compiled program on the
+    # routed step (the encode-once sharded step on this default 8-device mesh)
+    assert ex._frames_step_sharded._cache_size() == 1
 
 
 def test_shape_bucket_validation():

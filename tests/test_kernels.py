@@ -134,18 +134,20 @@ def test_resolve_corr_impl_auto_switches_on_volume_size(monkeypatch):
     # 16 pairs at 256²: pyramid 16·(32·32)²·4 B·1.328 ≈ 89 MB → volume
     assert resolve_corr_impl("auto", 16, 256, 256) == "volume"
     # 16 pairs at 1080p: 16·(135·240)²·4 B·1.328 ≈ 89 GB — several times
-    # HBM; the gather-free matmul remat is the big-frame default, with the
-    # env escape hatch back to the gather formulation
-    assert resolve_corr_impl("auto", 16, 1080, 1920) == "on_demand_matmul"
-    monkeypatch.setenv("VFT_RAFT_ON_DEMAND_IMPL", "gather")
+    # HBM; the GATHER on-demand path is the big-frame default (ADVICE r5:
+    # the matmul remat's FLOPs scale with frame area and its win was only
+    # measured at 64×64 on CPU), with the env escape hatch opting into the
+    # remat once a committed 1080p TPU sweep justifies the flip
     assert resolve_corr_impl("auto", 16, 1080, 1920) == "on_demand"
+    monkeypatch.setenv("VFT_RAFT_ON_DEMAND_IMPL", "matmul")
+    assert resolve_corr_impl("auto", 16, 1080, 1920) == "on_demand_matmul"
     monkeypatch.delenv("VFT_RAFT_ON_DEMAND_IMPL")
     # explicit choices pass through untouched
     for impl in ("volume", "volume_gather", "on_demand", "on_demand_matmul"):
         assert resolve_corr_impl(impl, 16, 1080, 1920) == impl
     # bf16 halves the volume: a geometry just past the fp32 budget fits
     monkeypatch.setenv("VFT_RAFT_VOLUME_BUDGET", str(16 * (32 * 32) ** 2 * 4))
-    assert resolve_corr_impl("auto", 16, 256, 256) == "on_demand_matmul"
+    assert resolve_corr_impl("auto", 16, 256, 256) == "on_demand"
     # mesh-sharded step: the budget is per DEVICE — 8 devices hold 2 pairs
     # each, so the same global batch fits (advisor round-3 finding)
     assert resolve_corr_impl("auto", 16, 256, 256, n_devices=8) == "volume"

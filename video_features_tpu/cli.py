@@ -72,10 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="RAFT correlation: auto (default) = materialized "
                              "pyramid with MXU matmul lookup unless the volume "
                              "would outgrow HBM for the frame size, then "
-                             "on_demand_matmul (the gather-free alt_cuda_corr "
-                             "equivalent: remat the volume slice per iteration "
-                             "on the MXU, O(H*W) memory); or force volume / "
-                             "volume_gather / on_demand / on_demand_matmul")
+                             "on_demand (the alt_cuda_corr equivalent, O(H*W) "
+                             "memory; VFT_RAFT_ON_DEMAND_IMPL=matmul opts into "
+                             "the MXU volume remat pending a 1080p TPU sweep); "
+                             "or force volume / volume_gather / on_demand / "
+                             "on_demand_matmul")
     parser.add_argument("--pwc_corr", choices=["auto", "xla", "pallas"],
                         default="auto",
                         help="PWC cost-volume implementation: auto picks the "
@@ -143,6 +144,24 @@ def build_parser() -> argparse.ArgumentParser:
                         help="reprocess exactly the videos in the failure manifest "
                              "(<output>/<feature_type>/.failed_manifest.jsonl) "
                              "instead of --video_paths/--file_with_video_paths")
+    parser.add_argument("--compilation_cache", default=None,
+                        help="persistent XLA compilation cache directory: "
+                             "compiles longer than ~1s are cached so reruns "
+                             "and restarts skip straight to execution "
+                             "(docs/performance.md)")
+    parser.add_argument("--precompile", action="store_true", default=False,
+                        help="flow models: warm the device program for each "
+                             "video's (bucketed) geometry in a background "
+                             "thread while the host decodes, overlapping "
+                             "mixed-resolution recompiles with decode "
+                             "(combine with --shape_bucket/--compilation_cache)")
+    parser.add_argument("--sync_writer", dest="async_writer",
+                        action="store_false", default=True,
+                        help="disable the async output writer and serialize "
+                             ".npy writes inside the per-video loop (the "
+                             "default writer thread overlaps serialization "
+                             "with the next video's compute, preserving "
+                             "atomic writes and write-before-done ordering)")
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")

@@ -70,9 +70,10 @@ class ExtractionConfig:
     flow_dtype: str = "float32"
     # RAFT correlation: "auto" (default) materializes the all-pairs pyramid
     # (reference default path, same numerics) unless the volume would outgrow
-    # HBM for the frame geometry, then switches to "on_demand_matmul" (the
-    # gather-free alt_cuda_corr equivalent — O(H·W·D) memory, per-iteration
-    # MXU volume remat; VFT_RAFT_ON_DEMAND_IMPL=gather reverts); explicit
+    # HBM for the frame geometry, then switches to "on_demand" (the
+    # alt_cuda_corr equivalent — O(H·W·D) memory; VFT_RAFT_ON_DEMAND_IMPL=
+    # matmul opts into the MXU volume remat once a committed 1080p TPU sweep
+    # justifies it — models/raft.py resolve_corr_impl, ADVICE r5); explicit
     # "volume"/"volume_gather"/"on_demand"/"on_demand_matmul" force a path.
     raft_corr: str = "auto"
     # PWC cost volume: "auto" (default) picks the Pallas tile kernel where its
@@ -107,6 +108,25 @@ class ExtractionConfig:
     # (vendored params). Off by default — the reference constructs the
     # postprocessor but never applies it (extract_vggish.py:57,104-116).
     vggish_postprocess: bool = False
+    # Persistent XLA compilation cache directory (jax_compilation_cache_dir):
+    # TPU compiles for large flow geometries cost 20-100 s each over the
+    # tunnel; a shared cache directory lets reruns and restarts skip straight
+    # to execution (compiles longer than ~1 s are cached). None = disabled.
+    compilation_cache: Optional[str] = None
+    # Flow extractors: as soon as a video's container is probed (its decoded
+    # geometry is then known), warm the jitted device program for that
+    # (bucketed) geometry in a background thread while the host decodes —
+    # a mixed-resolution corpus overlaps its serial mid-run recompiles with
+    # decode instead of stalling the mesh on each new geometry. Combine with
+    # --shape_bucket to bound the geometry count and --compilation_cache to
+    # persist the results across runs.
+    precompile: bool = False
+    # Overlap feature serialization with the next video's compute: .npy
+    # writes and done-manifest records run on a bounded single-writer thread
+    # (io/output.py AsyncOutputWriter) that preserves the atomic tmp+rename
+    # and write-before-done ordering; write failures surface classified per
+    # video (docs/performance.md). False = write inline in the video loop.
+    async_writer: bool = True
     # jax.profiler trace directory; also enables the per-video stage report
     # (decode vs device_wait vs overlapped time). VFT_METRICS=1 enables the
     # report without tracing.
@@ -207,11 +227,21 @@ class ExtractionConfig:
             raise ValueError("shape_bucket must be a multiple of 8 (RAFT /8 contract)")
         if self.transfer_dtype not in ("float32", "float16", "bfloat16"):
             raise ValueError("transfer_dtype must be float32|float16|bfloat16")
-        if self.i3d_crop_size < 32 or self.i3d_crop_size % 32:
+        if self.i3d_crop_size < 32:
+            raise ValueError("i3d_crop_size must be >= 32 (five /2 stages)")
+        if self.i3d_crop_size % 32:
             # five stride-2 stages: a non-multiple-of-32 crop produces odd
-            # intermediate dims (implementation-defined pooling geometry)
-            raise ValueError("i3d_crop_size must be a multiple of 32 "
-                             "(five /2 stages)")
+            # intermediate dims (implementation-defined pooling geometry).
+            # Legal — 112 is a common I3D crop — so warn instead of rejecting
+            # (ADVICE r5); README documents that features may drift across
+            # backends at such sizes.
+            import sys
+
+            print(f"warning: i3d_crop_size {self.i3d_crop_size} is not a "
+                  "multiple of 32; five stride-2 stages produce odd "
+                  "intermediate dims (implementation-defined pooling "
+                  "geometry) — features may differ across backends",
+                  file=sys.stderr)
         if self.i3d_pre_crop_size < self.i3d_crop_size:
             raise ValueError("i3d_pre_crop_size must be >= i3d_crop_size")
 

@@ -16,6 +16,7 @@ import os
 import sys
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -23,14 +24,16 @@ import numpy as np
 from ..config import ExtractionConfig, resolve_model_defaults
 from ..io.filelist import form_video_list
 from ..io.output import (
-    action_on_extraction,
+    AsyncOutputWriter,
+    WriteHandle,
     feature_output_dir,
     load_done_set,
-    mark_done,
+    write_outputs,
 )
 from ..io.video import open_video
 from ..parallel import MeshRunner
 from ..parallel.pipeline import DecodePrefetcher
+from ..parallel.mesh import enable_compilation_cache
 from ..reliability import (
     CircuitBreakerTripped,
     RetryPolicy,
@@ -59,6 +62,10 @@ class Extractor(abc.ABC):
         cfg.validate()
         self.cfg = cfg
         self.feature_type = cfg.feature_type
+        # persistent compilation cache (--compilation_cache): applied before
+        # the mesh (and so before any compile) — see docs/performance.md
+        if cfg.compilation_cache:
+            enable_compilation_cache(cfg.compilation_cache)
         # per-feature-type subdirs, as the reference joins them (extract_i3d.py:77-78)
         self.output_dir = feature_output_dir(cfg.output_path, cfg.feature_type)
         self.tmp_dir = os.path.join(cfg.tmp_path, cfg.feature_type)
@@ -70,6 +77,13 @@ class Extractor(abc.ABC):
         self.clock: Optional[StageClock] = None
         # cross-video decode pool; created by run() when --decode_workers > 1
         self._decode_pool: Optional[DecodePrefetcher] = None
+        # async output writer; created by run() for save_numpy jobs unless
+        # --sync_writer opted out. _pending_writes holds (path, WriteHandle)
+        # for extractions whose output is still on the writer thread — on
+        # self (not loop-local) so an interrupted run can still account the
+        # writes the writer drains during shutdown
+        self._writer: Optional[AsyncOutputWriter] = None
+        self._pending_writes: deque = deque()
         # videos that succeeded in the current run() (failure-manifest pruning)
         self._succeeded: List[str] = []
 
@@ -156,6 +170,17 @@ class Extractor(abc.ABC):
         elif workers > 1:
             print(f"--decode_workers ignored: {self.feature_type} does not "
                   "consume the frame stream (whole-video / audio decode)")
+        if self.cfg.async_writer and self.cfg.on_extraction == "save_numpy":
+            # bounded single-writer thread: .npy serialization overlaps the
+            # next video's compute; write failures retry like any other
+            # transient OutputError, then surface at the per-video reap.
+            # depth 2 + the loop's reap-to-one discipline (_run_loop
+            # reap_writes(1)) guarantee submit() never blocks inside a
+            # video's watchdog window on a predecessor's slow write.
+            self._writer = AsyncOutputWriter(
+                depth=2,
+                retry=RetryPolicy(attempts=self.cfg.retries + 1,
+                                  base_delay=self.cfg.retry_backoff))
         self._succeeded: List[str] = []  # pruned from the failure manifest at exit
         try:
             return self._run_loop(paths, done, with_metrics, progress)
@@ -166,18 +191,40 @@ class Extractor(abc.ABC):
             if self._decode_pool is not None:
                 self._decode_pool.shutdown()
                 self._decode_pool = None
+            # drain the writer even on interrupt/breaker: queued jobs finish
+            # their atomic writes + done records (write-before-done holds),
+            # then account the drained handles so videos that DID complete
+            # reach _succeeded (their stale failure records must be pruned —
+            # a --retry_failed pass interrupted after its last extract would
+            # otherwise leave a video in both manifests forever)
+            if self._writer is not None:
+                self._writer.close(wait=True)
+                self._writer = None
+                self._reap_abandoned_writes()
             # even on KeyboardInterrupt / circuit breaker: converge the failure
             # manifest for everything that DID succeed this run
             self._prune_succeeded(self._succeeded)
 
-    def _process_one(self, path: str, cancelled: Optional[threading.Event] = None) -> None:
+    def _process_one(self, path: str,
+                     cancelled: Optional[threading.Event] = None,
+                     ) -> Optional[WriteHandle]:
         """One attempt at one video: extract → output action → mark done.
+
+        With the async writer active the action + done record are SUBMITTED
+        (not performed): the returned :class:`WriteHandle` resolves on the
+        writer thread while the loop moves to the next video, and the run
+        loop's reap attributes any write failure back to this video. Inline
+        mode returns None after writing synchronously.
 
         ``cancelled`` is set by the watchdog on timeout: an abandoned attempt
         that later wakes up (typically over a partial frame stream — releasing
         the decode-pool slot turns the remaining frames into a clean-looking
         EOF) must discard its results, not write truncated features and a
         done-manifest record for a video the run already counted as failed.
+        The check sits BEFORE the submit, so watchdog-cancelled attempts
+        never enqueue writes — and the submitted job carries the event, so a
+        cancellation landing after this check is still discarded by the
+        writer before the done record.
         """
 
         def check_cancelled(stage: str) -> None:
@@ -188,18 +235,31 @@ class Extractor(abc.ABC):
         fault_point("extract", path)
         feats_dict = self.extract(path)
         check_cancelled("discarding possibly-partial features")
-        action_on_extraction(feats_dict, path, self.output_dir, self.cfg.on_extraction)
-        if self.cfg.on_extraction == "save_numpy":
-            check_cancelled("features written but NOT marked done")
-            mark_done(self.output_dir, path, feats_dict.keys())
+        if self._writer is not None:
+            # the job carries the cancel event: a timeout landing between
+            # this check and the writer thread picking the job up (or
+            # mid-write) still discards before the done record. This put
+            # cannot block on a full queue — the run loop reaps down to one
+            # outstanding write before starting the next attempt — so a
+            # PREDECESSOR's slow write stalls the loop in reap_writes
+            # (outside any watchdog), never this video's timeout budget.
+            return self._writer.submit(feats_dict, path, self.output_dir,
+                                       self.cfg.on_extraction,
+                                       cancelled=cancelled)
+        # inline mode: the same shared write contract, on this thread
+        write_outputs(feats_dict, path, self.output_dir,
+                      self.cfg.on_extraction, cancelled=cancelled)
+        return None
 
-    def _attempt_with_retries(self, path: str) -> None:
+    def _attempt_with_retries(self, path: str) -> Optional[WriteHandle]:
         """Run one video under the watchdog + transient-retry policy.
 
         Each attempt is watchdog-bounded individually (``--video_timeout``
         limits an *attempt*, not the retry budget). Between attempts the
         decode-pool slot is released so the retry decodes fresh — the stale
-        prefetched stream may itself be the failure.
+        prefetched stream may itself be the failure. Returns the async
+        writer's handle for this video's pending output (None in inline
+        mode).
         """
 
         def on_retry(exc, attempt, delay):
@@ -216,12 +276,35 @@ class Extractor(abc.ABC):
                 self.cfg.video_timeout, path, on_timeout=cancel.set,
             )
 
-        retry_call(
+        return retry_call(
             attempt_once,
             RetryPolicy(attempts=self.cfg.retries + 1,
                         base_delay=self.cfg.retry_backoff),
             on_retry=on_retry,
         )
+
+    def _reap_abandoned_writes(self) -> None:
+        """Account writes the closed writer drained after the loop stopped.
+
+        Runs in ``run()``'s ``finally`` with the writer already closed, so
+        every handle has resolved: successes join ``_succeeded`` (their
+        stale failure records get pruned), failures are best-effort recorded
+        — never raised (this is an unwind path; the in-flight exception, if
+        any, must win) and never circuit-breaker counted.
+        """
+        while self._pending_writes:
+            wpath, handle = self._pending_writes.popleft()
+            try:
+                handle.wait()
+            except Exception as e:  # noqa: BLE001 — fault-barrier: unwind-path write accounting; must not mask the in-flight exception
+                try:
+                    record_failure(self.output_dir, wpath,
+                                   e, getattr(e, "attempts", 1))
+                except OSError as rec_err:
+                    print(f"warning: could not record failure for {wpath}: "
+                          f"{rec_err}", file=sys.stderr)
+                continue
+            self._succeeded.append(wpath)
 
     def _prune_succeeded(self, succeeded: List[str]) -> None:
         """Drop stale failure records for videos that just succeeded.
@@ -254,13 +337,81 @@ class Extractor(abc.ABC):
         workers = self.cfg.decode_workers
         ok = 0
         extracted = 0  # excludes resume-skipped videos (throughput honesty)
+        resumed = 0  # tracked directly: ok - extracted no longer equals it
+        # when an async write fails (extracted counts the successful extract,
+        # ok only counts writes that resolved)
         failures = 0
         cursor = 0  # decode-window cursor over `todo`
+        # async-writer mode: a video counts `ok` only once its write
+        # resolved, so the done/failure manifests and the return value agree
+        # with the synchronous path exactly; the deque lives on self so
+        # run()'s finally can account handles an interrupt abandoned
+        pending_writes = self._pending_writes
+        pending_writes.clear()
         t_run = time.perf_counter()
+
+        def fail(path, e) -> None:
+            """Per-video failure accounting — the barrier and the write reap
+            share it so a write failure is recorded exactly like a compute
+            one (classified, manifested, circuit-breaker counted)."""
+            nonlocal failures
+            failures += 1
+            err_class, transient = classify(e)
+            attempts = getattr(e, "attempts", 1)
+            # best-effort: the manifest write hitting the same dying
+            # disk as the failure itself must not escape the barrier
+            try:
+                record = record_failure(self.output_dir, path, e, attempts)
+                digest = record["traceback_digest"]
+            except OSError as rec_err:
+                digest = "unrecorded"
+                print(f"warning: could not record failure for {path}: "
+                      f"{rec_err}", file=sys.stderr)
+            print(e)
+            print(f"Extraction failed at: {path} with error (↑). "
+                  f"Continuing extraction "
+                  f"[{err_class}, transient={transient}, "
+                  f"attempts={attempts}, digest={digest}]")
+            if (self.cfg.max_failures is not None
+                    and failures > self.cfg.max_failures):
+                raise CircuitBreakerTripped(
+                    f"{failures} videos failed (> --max_failures "
+                    f"{self.cfg.max_failures}); aborting — a failure "
+                    "rate this high usually has a systemic cause. "
+                    "Failures so far are recorded in the failure "
+                    "manifest; fix the cause and rerun with "
+                    "--retry_failed."
+                ) from e
+
+        def reap_writes(limit: int) -> None:
+            """Resolve oldest pending writes until ≤ ``limit`` remain.
+
+            Peek-then-pop: a KeyboardInterrupt inside ``handle.wait()``
+            (Event.wait is signal-interruptible) must leave the tuple in the
+            deque so the shutdown drain (:meth:`_reap_abandoned_writes`) can
+            still account the write — a popped-then-lost handle would strand
+            its video's stale failure record forever.
+            """
+            nonlocal ok
+            while len(pending_writes) > limit:
+                wpath, handle = pending_writes[0]
+                try:
+                    handle.wait()
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — fault-barrier: the write-side arm of the per-video isolation point
+                    pending_writes.popleft()
+                    fail(wpath, e)
+                    continue
+                pending_writes.popleft()
+                ok += 1
+                self._succeeded.append(wpath)
+
         with maybe_profiler(self.cfg.profile_dir):
             for n, path in enumerate(paths, start=1):
                 if os.path.abspath(path) in done:
                     ok += 1
+                    resumed += 1
                     if progress:
                         progress(n, len(paths))
                     continue
@@ -272,42 +423,19 @@ class Extractor(abc.ABC):
                 self.clock = StageClock() if with_metrics else None
                 t0 = time.perf_counter()
                 try:
-                    self._attempt_with_retries(path)
-                    ok += 1
+                    handle = self._attempt_with_retries(path)
                     extracted += 1
-                    self._succeeded.append(path)
                     if self.clock is not None:
                         print(self.clock.report(path, time.perf_counter() - t0))
+                    if handle is not None:
+                        pending_writes.append((path, handle))
+                    else:
+                        ok += 1
+                        self._succeeded.append(path)
                 except KeyboardInterrupt:
                     raise
                 except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point
-                    failures += 1
-                    err_class, transient = classify(e)
-                    attempts = getattr(e, "attempts", 1)
-                    # best-effort: the manifest write hitting the same dying
-                    # disk as the failure itself must not escape the barrier
-                    try:
-                        record = record_failure(self.output_dir, path, e, attempts)
-                        digest = record["traceback_digest"]
-                    except OSError as rec_err:
-                        digest = "unrecorded"
-                        print(f"warning: could not record failure for {path}: "
-                              f"{rec_err}", file=sys.stderr)
-                    print(e)
-                    print(f"Extraction failed at: {path} with error (↑). "
-                          f"Continuing extraction "
-                          f"[{err_class}, transient={transient}, "
-                          f"attempts={attempts}, digest={digest}]")
-                    if (self.cfg.max_failures is not None
-                            and failures > self.cfg.max_failures):
-                        raise CircuitBreakerTripped(
-                            f"{failures} videos failed (> --max_failures "
-                            f"{self.cfg.max_failures}); aborting — a failure "
-                            "rate this high usually has a systemic cause. "
-                            "Failures so far are recorded in the failure "
-                            "manifest; fix the cause and rerun with "
-                            "--retry_failed."
-                        ) from e
+                    fail(path, e)
                 finally:
                     self.clock = None
                     if self._decode_pool is not None:
@@ -315,12 +443,19 @@ class Extractor(abc.ABC):
                         # drained or abandoned by a compute error — an orphaned
                         # worker would pin a permit + max_buffered frames forever
                         self._decode_pool.release(path)
+                # bound in-flight writes: the current video's serialization
+                # overlaps the NEXT video's decode/compute, older writes must
+                # resolve (and be accounted) first. OUTSIDE the barrier: a
+                # CircuitBreakerTripped from the reap must abort the run, not
+                # be swallowed as video `path`'s failure.
+                reap_writes(1)
                 if progress:
                     progress(n, len(paths))
+            reap_writes(0)  # the tail videos' writes resolve before run() returns
         if with_metrics and extracted:
             dt = time.perf_counter() - t_run
             print(f"extracted {extracted}/{len(paths)} videos "
-                  f"({ok - extracted} resumed) in {dt:.2f}s "
+                  f"({resumed} resumed) in {dt:.2f}s "
                   f"({extracted / dt:.3f} videos/sec)")
         return ok
 

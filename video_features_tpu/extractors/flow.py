@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from collections import deque
 from typing import Dict, List
 
@@ -35,10 +36,11 @@ from ..models.raft import (
     pad_to_multiple,
     raft_forward,
     raft_forward_frames,
+    raft_forward_frames_sharded,
     raft_init_params,
     unpad,
 )
-from ..ops.image import pil_edge_resize
+from ..ops.image import edge_resize_size, pil_edge_resize
 from ..weights.convert_torch import convert_raft
 from ..weights.store import resolve_params
 from .base import Extractor
@@ -58,6 +60,9 @@ class ExtractFlow(Extractor):
         self.batch_size = self.runner.device_batch(cfg.batch_size)
         self._viz_counter = 0  # --show_pred PNG fallback numbering
         self._async_copy_ok = True  # cleared on first missing-API probe
+        # --precompile: geometries already warmed (or warming) in background
+        self._precompiled: set = set()
+        self._precompile_lock = threading.Lock()
         flow_dtype = jnp.bfloat16 if cfg.flow_dtype == "bfloat16" else jnp.float32
         # D2H transfer dtype: the jitted steps cast their output to this on
         # device; the host upcasts back to fp32. float16 halves the fetched
@@ -80,9 +85,17 @@ class ExtractFlow(Extractor):
             self._forward_frames = functools.partial(
                 raft_forward_frames, corr_impl=cfg.raft_corr, dtype=flow_dtype,
                 n_devices=self.runner.num_devices)
+            self._forward_frames_sharded = functools.partial(
+                raft_forward_frames_sharded, mesh=self.runner.mesh,
+                corr_impl=cfg.raft_corr, dtype=flow_dtype)
             self._pads_input = True
         elif self.feature_type == "pwc":
-            from ..models.pwc import pwc_forward, pwc_forward_frames, pwc_init_params
+            from ..models.pwc import (
+                pwc_forward,
+                pwc_forward_frames,
+                pwc_forward_frames_sharded,
+                pwc_init_params,
+            )
             from ..weights.convert_torch import convert_pwc
 
             self.params = self.runner.put_replicated(
@@ -98,6 +111,10 @@ class ExtractFlow(Extractor):
             self._forward_frames = functools.partial(
                 pwc_forward_frames, corr_impl=cfg.pwc_corr, dtype=flow_dtype,
                 warp_impl=cfg.pwc_warp)
+            self._forward_frames_sharded = functools.partial(
+                pwc_forward_frames_sharded, mesh=self.runner.mesh,
+                corr_impl=cfg.pwc_corr, dtype=flow_dtype,
+                warp_impl=cfg.pwc_warp)
             self._pads_input = False
         else:
             raise ValueError(f"not a flow feature type: {self.feature_type}")
@@ -107,9 +124,12 @@ class ExtractFlow(Extractor):
         fwd = self._forward
         tdt = self._transfer_dtype
 
-        # pairs are pre-split on host into (prev, nxt) of equal leading size B so
-        # both shard cleanly along the mesh's data axis (a single (B+1,)-frames
-        # array cannot: pair i needs frames i and i+1 — a halo across shards)
+        # pair-split step: (prev, nxt) of equal leading size B shard cleanly
+        # along the mesh's data axis at the cost of encoding every interior
+        # frame twice. No longer the production multi-device path (the
+        # encode-once _frames_step_sharded replaced it) — retained as the
+        # parity reference the sharded paths are tested against and for the
+        # dryrun/bench harnesses that compare both.
         def step(params, prev, nxt):  # each (B, H, W, 3) float32
             return fwd(params, prev, nxt).astype(tdt)
 
@@ -128,8 +148,42 @@ class ExtractFlow(Extractor):
 
         return self.runner.jit(step)
 
+    @functools.cached_property
+    def _frames_step_sharded(self):
+        fwd = self._forward_frames_sharded
+        tdt = self._transfer_dtype
+
+        # multi-device encode-once step: the (B+1)-frame window arrives as its
+        # B source frames sharded on the frame axis plus the replicated final
+        # frame; each shard's one cross-shard pair is formed on device by halo
+        # exchange of the neighbor's boundary feature map
+        # (models/{raft,pwc}.*_forward_frames_sharded), so every frame's
+        # encoder/pyramid runs exactly once — the pair-split step this
+        # replaces encoded every interior frame twice
+        def step(params, frames, frame_last):
+            # (B, H, W, 3) sharded + (1, H, W, 3) replicated, float32
+            return fwd(params, frames, frame_last).astype(tdt)
+
+        return self.runner.jit(step, n_batch_args=1, n_replicated_args=1)
+
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.cfg.side_size, self.cfg.resize_to_smaller_edge)
+
+    def _device_call(self, frames: np.ndarray):
+        """Dispatch one PADDED (batch_size+1)-frame window to the jitted step.
+
+        Single-device meshes run the shared-frame step whole; multi-device
+        meshes shard the B source frames on the frame axis and replicate the
+        final frame (encode-once everywhere — no mesh size re-encodes
+        interior frames). The --precompile warmup calls this with a zeros
+        window so the warmed program is EXACTLY the one real dispatch uses.
+        """
+        if self.runner.num_devices == 1:
+            dev = self.runner.put(np.ascontiguousarray(frames))
+            return self._frames_step(self.params, dev)
+        main = self.runner.put(np.ascontiguousarray(frames[:-1]))
+        last = self.runner.put_replicated(np.ascontiguousarray(frames[-1:]))
+        return self._frames_step_sharded(self.params, main, last)
 
     def _dispatch_pairs(self, frames: np.ndarray):
         """Dispatch one pair window to the device; returns an async handle.
@@ -152,15 +206,7 @@ class ExtractFlow(Extractor):
             frames, pads = pad_to_multiple(frames, self.cfg.shape_bucket)
         elif self._pads_input:
             frames, pads = pad_to_multiple(frames, 8)
-        if self.runner.num_devices == 1:
-            # shared-frame step: every frame encoded once (B+1 frames don't
-            # shard evenly over a multi-device mesh, so this is single-chip)
-            dev = self.runner.put(np.ascontiguousarray(frames))
-            flow = self._frames_step(self.params, dev)
-        else:
-            prev = self.runner.put(np.ascontiguousarray(frames[:-1]))
-            nxt = self.runner.put(np.ascontiguousarray(frames[1:]))
-            flow = self._step(self.params, prev, nxt)
+        flow = self._device_call(frames)
         if self._async_copy_ok:
             try:
                 flow.copy_to_host_async()
@@ -193,8 +239,60 @@ class ExtractFlow(Extractor):
         """Flow for all consecutive pairs of (N, H, W, 3) float frames → (N-1, 2, H, W)."""
         return self._collect_pairs(self._dispatch_pairs(frames))
 
+    # --- geometry precompile (--precompile) --------------------------------
+
+    def _padded_geometry(self, width: int, height: int):
+        """(H, W) of the padded device window a native ``width``×``height``
+        video will dispatch: the host edge-resize sizing followed by the
+        shape_bucket (or RAFT /8) padding — the same arithmetic
+        ``_host_transform`` + ``_dispatch_pairs`` apply per frame."""
+        if self.cfg.side_size is not None:
+            w, h = edge_resize_size(width, height, self.cfg.side_size,
+                                    self.cfg.resize_to_smaller_edge)
+        else:
+            w, h = width, height
+        m = self.cfg.shape_bucket or (8 if self._pads_input else 1)
+        return -(-h // m) * m, -(-w // m) * m
+
+    def _start_precompile(self, width: int, height: int) -> None:
+        """Warm the jitted step for this video's geometry while decode runs.
+
+        Mixed-resolution corpora otherwise pay each new geometry's compile
+        (20-100 s over a TPU tunnel) serially at the first dispatch, with the
+        mesh idle. The video's decoded geometry is known from the container
+        probe before any frame decodes, so a daemon thread runs the step once
+        on a zeros window of the padded geometry — jit's signature cache is
+        shared across threads, so the real first window either finds the
+        program compiled or blocks on the in-flight compile instead of
+        starting its own. One wasted zeros execution per NEW geometry; repeat
+        geometries return immediately.
+        """
+        h, w = self._padded_geometry(width, height)
+        with self._precompile_lock:
+            if (h, w) in self._precompiled:
+                return
+            self._precompiled.add((h, w))
+
+        def warm():
+            try:
+                import jax
+
+                window = np.zeros((self.batch_size + 1, h, w, 3), np.float32)
+                jax.block_until_ready(self._device_call(window))
+            except Exception as e:  # noqa: BLE001 — fault-barrier: best-effort warmup; the real dispatch compiles inline and surfaces any genuine error
+                print(f"[flow] geometry precompile ({h}x{w}) failed: "
+                      f"{type(e).__name__}: {e}; the first window will "
+                      "compile inline", flush=True)
+
+        threading.Thread(target=warm, daemon=True,
+                         name=f"flow-precompile:{h}x{w}").start()
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = self._open_video(video_path)
+        if self.cfg.precompile:
+            # geometry known from the container probe: overlap this video's
+            # (possibly first-of-its-geometry) compile with its decode
+            self._start_precompile(meta.width, meta.height)
         timestamps_ms: List[float] = []
         flow_frames: List[np.ndarray] = []
         window: List[np.ndarray] = []

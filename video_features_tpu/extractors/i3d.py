@@ -39,8 +39,12 @@ import jax
 import jax.numpy as jnp
 
 from ..models.i3d import I3D, i3d_preprocess_flow, i3d_preprocess_rgb
-from ..models.pwc import pwc_forward_frames, pwc_init_params
-from ..models.raft import raft_forward_frames, raft_init_params
+from ..models.pwc import pwc_forward_frames, pwc_forward_frames_sharded, pwc_init_params
+from ..models.raft import (
+    raft_forward_frames,
+    raft_forward_frames_sharded,
+    raft_init_params,
+)
 from ..ops.image import pil_edge_resize
 from ..parallel import prefetch_to_device
 from ..utils.labels import show_predictions_on_dataset
@@ -75,6 +79,34 @@ class ExtractI3D(Extractor):
         # stacks per device step, rounded to a multiple of the mesh size
         self.clips_per_batch = self.runner.device_batch(cfg.clips_per_batch)
         self.dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        # Encode-once frame sharding: a flow-only single-clip job on a
+        # multi-device mesh shards the stack's FRAME axis across devices
+        # (halo exchange forms each shard's cross-shard pair —
+        # models/{raft,pwc}.*_forward_frames_sharded) instead of rounding the
+        # clip axis up to the mesh, where D-1 of D padded clips were pure
+        # waste at video tails and the mesh idled whenever fewer clips than
+        # devices were in flight. The sandwich's dominant stage (the flow
+        # net) then spans the whole mesh per clip. Two-stream jobs keep clip
+        # sharding: both streams consume the same device batch, and the rgb
+        # stream has no frame-pair structure to shard along.
+        self._flow_frame_sharded = (
+            self.runner.num_devices > 1
+            and self.streams == ("flow",)
+            and cfg.clips_per_batch == 1
+            and self.stack_size % self.runner.num_devices == 0
+        )
+        if (self._flow_frame_sharded and self.flow_type == "pwc"
+                and cfg.flow_pair_chunk is not None):
+            # the frame-sharded step decodes each shard's stack_size/D pairs
+            # in one piece (no lax.map chunking); a user explicitly bounding
+            # decoder memory with --flow_pair_chunk must get the path that
+            # honors it rather than a silent OOM
+            print("--flow_pair_chunk set: keeping the clip-sharded flow step "
+                  "(the frame-sharded encode-once step does not chunk the "
+                  "per-shard decode)")
+            self._flow_frame_sharded = False
+        if self._flow_frame_sharded:
+            self.clips_per_batch = 1  # one frame-sharded clip per step
 
         # VFT_I3D_S2D=1 opts into the space-to-depth stem lowering; measured
         # SLOWER on v5e (the fold relayout costs more than the small-channel
@@ -204,6 +236,60 @@ class ExtractI3D(Extractor):
 
         return self.runner.jit(step)
 
+    @functools.cached_property
+    def _flow_step_sharded(self):
+        """Frame-sharded flow sandwich (``_flow_frame_sharded`` mode): ONE
+        clip per step, its stack_size source frames sharded across the mesh
+        plus the replicated final frame. The flow net runs encode-once with
+        halo-exchanged pair boundaries; the I3D conv stack consumes the
+        sharded flow under GSPMD (XLA partitions or gathers as profitable —
+        the flow net dominates the sandwich either way)."""
+        model = self.i3d["flow"]
+        flow_type = self.flow_type
+        flow_params = self.flow_params
+        with_pred = self.cfg.show_pred
+        dtype = self.dtype
+        flow_dtype = (jnp.bfloat16 if self.cfg.flow_dtype == "bfloat16"
+                      else jnp.float32)
+        raft_corr = self.cfg.raft_corr
+        pwc_corr = self.cfg.pwc_corr
+        pwc_warp = self.cfg.pwc_warp
+        crop = self.crop_size
+        mesh = self.runner.mesh
+
+        def step(params, frames_u8, last_u8):
+            # frames_u8: (S, H, W, 3) uint8 sharded on the frame axis;
+            # last_u8: (1, H, W, 3) replicated — together one (S+1)-frame stack
+            s, h, w, _c = frames_u8.shape
+            frames = frames_u8.astype(jnp.float32)
+            last = last_u8.astype(jnp.float32)
+            if flow_type == "raft":
+                # replicate-pad to /8 and, like the reference, never unpad:
+                # the 224 center crop below runs on the padded flow
+                ph, pw = (8 - h % 8) % 8, (8 - w % 8) % 8
+                pads = ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0))
+                flow = raft_forward_frames_sharded(
+                    flow_params, jnp.pad(frames, pads, mode="edge"),
+                    jnp.pad(last, pads, mode="edge"), mesh,
+                    corr_impl=raft_corr, dtype=flow_dtype)
+            else:
+                # per-shard pair count is stack_size/D — already a bounded
+                # decoder batch, so --flow_pair_chunk does not apply here
+                flow = pwc_forward_frames_sharded(
+                    flow_params, frames, last, mesh,
+                    corr_impl=pwc_corr, dtype=flow_dtype, warp_impl=pwc_warp)
+            # flow: (S, Hp, Wp, 2) sharded on the pair axis → one clip
+            x = i3d_preprocess_flow(_center_crop_nhwc(flow[None], crop),
+                                    dtype=dtype)
+            feats = model.apply({"params": params}, x, features=True)
+            if with_pred:
+                _, logits = model.apply({"params": params}, x, features=False)
+                return feats, logits
+            return feats, None
+
+        return self.runner.jit(step, n_batch_args=1, n_replicated_args=1)
+
     # --- pipeline -----------------------------------------------------------
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
@@ -233,18 +319,35 @@ class ExtractI3D(Extractor):
                 yield pad_batch(np.stack(batch), self.clips_per_batch)
             # trailing partial *stack* dropped, as in the reference (:216-219)
 
+        if self._flow_frame_sharded:
+            # one clip per step: split each (1, S+1, H, W, 3) stack into its S
+            # source frames (sharded on the frame axis) + the final frame
+            # (replicated) so the encode-once flow step spans the mesh
+            def host_batches():
+                for batch in stack_batches():
+                    yield batch[0, :-1], batch[0, -1:]
+
+            sharding = (self.runner.batch_sharding, self.runner.replicated)
+        else:
+            host_batches = stack_batches
+            sharding = self.runner.batch_sharding
+
         # host decode/stacking of batch k+1 overlaps device compute of batch k
         for i, dev_batch in enumerate(
             prefetch_to_device(
-                stack_batches(),
-                sharding=self.runner.batch_sharding,
+                host_batches(),
+                sharding=sharding,
                 depth=self.cfg.prefetch_depth,
             )
         ):
             valid = valid_counts[i]
             for stream in self.streams:
-                step = self._rgb_step if stream == "rgb" else self._flow_step
-                feats, logits = step(self.i3d_params[stream], dev_batch)
+                if stream == "flow" and self._flow_frame_sharded:
+                    feats, logits = self._flow_step_sharded(
+                        self.i3d_params["flow"], *dev_batch)
+                else:
+                    step = self._rgb_step if stream == "rgb" else self._flow_step
+                    feats, logits = step(self.i3d_params[stream], dev_batch)
                 # stays on device; one host fetch per stream per video
                 feats_dict[stream].append(feats[:valid])
                 self._throttle(feats_dict[stream])

@@ -8,6 +8,10 @@ interrupted jobs can resume (the reference reruns everything — SURVEY.md §5).
 Writes are atomic (tmp + ``os.replace``): a SIGKILL mid-save must never leave a
 truncated ``.npy`` that a later ``--resume`` counts as done. Filesystem failures
 raise :class:`~..reliability.OutputError` (transient — disk/NFS pressure clears).
+
+:class:`AsyncOutputWriter` (default, ``--sync_writer`` reverts) moves the
+save + mark-done pair onto a bounded single-writer thread so serialization
+overlaps the next video's compute; ordering and atomicity are unchanged.
 """
 
 from __future__ import annotations
@@ -15,12 +19,15 @@ from __future__ import annotations
 import json
 import os
 import pathlib
+import queue
 import sys
-from typing import Dict, Mapping
+import threading
+from typing import Dict, Mapping, Optional
 
 import numpy as np
 
-from ..reliability import OutputError, fault_point
+from ..reliability import OutputError, VideoTimeoutError, fault_point
+from ..reliability.retry import RetryPolicy, retry_call
 from ..reliability.manifest import read_jsonl
 
 MANIFEST_NAME = ".done_manifest.jsonl"
@@ -91,6 +98,147 @@ def action_on_extraction(
         else:
             raise NotImplementedError(f"on_extraction: {on_extraction} is not implemented")
     return saved
+
+
+def write_outputs(feats_dict: Mapping[str, np.ndarray], video_path: str,
+                  output_path: str, on_extraction: str = "save_numpy",
+                  cancelled: Optional[threading.Event] = None) -> None:
+    """One video's complete output sequence — THE single implementation of
+    the write-before-done / cancellation contract, shared by the inline
+    path (:meth:`Extractor._process_one`) and the async writer thread:
+
+    1. re-check the watchdog cancel event before touching disk;
+    2. ``action_on_extraction`` (atomic per-array tmp+rename saves);
+    3. re-check the cancel event — features may exist, but a cancelled
+       attempt must NOT be marked done;
+    4. append the done-manifest record.
+    """
+
+    def check_cancelled(stage: str) -> None:
+        if cancelled is not None and cancelled.is_set():
+            raise VideoTimeoutError(
+                f"{video_path}: attempt was cancelled by the watchdog; {stage}")
+
+    check_cancelled("discarding features before any write")
+    action_on_extraction(feats_dict, video_path, output_path, on_extraction)
+    if on_extraction == "save_numpy":
+        # write-before-done ordering: the record lands only after every
+        # .npy of this video has been atomically renamed into place
+        check_cancelled("features written but NOT marked done")
+        mark_done(output_path, video_path, feats_dict.keys())
+
+
+class WriteHandle:
+    """Completion token for one video's asynchronous output write."""
+
+    __slots__ = ("video", "_done", "_error")
+
+    def __init__(self, video: str):
+        self.video = video
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the write completed; re-raises its classified error.
+
+        Returns False if ``timeout`` expired with the write still pending.
+        """
+        if not self._done.wait(timeout):
+            return False
+        if self._error is not None:
+            raise self._error
+        return True
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class AsyncOutputWriter:
+    """Bounded single-writer thread: overlap feature serialization with the
+    next video's compute.
+
+    ``action_on_extraction`` + ``mark_done`` previously ran inside the
+    per-video loop, serializing multi-GB dense-flow ``.npy`` writes against
+    device compute; here they run on one background thread while the loop
+    moves on. The PR-1 reliability invariants are preserved by construction
+    (pinned by tests/test_async_writer.py + tests/test_fault_injection.py):
+
+    - jobs run strictly in submission order (one queue, one thread);
+    - within a job, features are written first (atomic tmp+rename,
+      :func:`_atomic_save`) and the done-manifest record appended AFTER —
+      a kill at any point leaves either no visible output or a complete one;
+    - a failed job surfaces its classified :class:`OutputError` on that
+      job's :class:`WriteHandle` only, optionally after transient retries
+      (``retry``), never on another video's handle;
+    - the queue is bounded: a slow disk backpressures :meth:`submit` (the
+      extraction loop) instead of pinning every finished video's features in
+      host memory.
+    """
+
+    def __init__(self, depth: int = 2, retry: Optional[RetryPolicy] = None):
+        self._q: queue.Queue = queue.Queue(maxsize=max(depth, 1))
+        self._retry = retry
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="output-writer")
+        self._thread.start()
+
+    def submit(self, feats_dict: Mapping[str, np.ndarray], video_path: str,
+               output_path: str, on_extraction: str = "save_numpy",
+               cancelled: Optional[threading.Event] = None) -> WriteHandle:
+        """Enqueue one video's output job; blocks when the queue is full.
+
+        ``cancelled``: the attempt's watchdog cancellation event. The job
+        re-checks it before touching disk and again between the feature
+        writes and the done record — the same two points the inline path
+        checks — so an attempt whose timeout fires in the check-to-submit
+        window (or mid-write) can never leave a done-manifest record for a
+        video the run counted as failed.
+        """
+        if self._closed:
+            raise OutputError("output writer is closed")
+        if not self._thread.is_alive():
+            raise OutputError("output writer thread died")
+        handle = WriteHandle(video_path)
+        self._q.put((handle, feats_dict, video_path, output_path, on_extraction,
+                     cancelled))
+        return handle
+
+    _run_one = staticmethod(write_outputs)  # one write-contract implementation
+
+    def _drain(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            handle, *job = item
+            try:
+                if self._retry is not None:
+                    # OutputError is transient (disk/NFS pressure clears);
+                    # retrying here re-runs idempotent steps only — atomic
+                    # saves overwrite, duplicate done records collapse into
+                    # the load_done_set set
+                    retry_call(lambda: self._run_one(*job), self._retry)  # noqa: B023
+                else:
+                    self._run_one(*job)
+            except Exception as e:  # noqa: BLE001 — fault-barrier: stored on the handle, re-raised classified at the run loop's per-video write reap
+                handle._error = e
+            finally:
+                handle._done.set()
+
+    def close(self, wait: bool = True) -> None:
+        """Stop accepting jobs; by default drain queued jobs first.
+
+        ``wait=True`` joins the thread after it finishes everything already
+        queued — on interrupts the physical writes (and their ordering) still
+        complete even if the caller no longer collects the handles.
+        """
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        if wait:
+            self._thread.join()
 
 
 def manifest_path(output_path: str) -> str:

@@ -213,6 +213,52 @@ def pwc_forward_frames(params: Dict, frames: jnp.ndarray,
     return flow.reshape(lead[:-1] + (f - 1, h, w, 2))
 
 
+def pwc_forward_frames_sharded(params: Dict, frames: jnp.ndarray,
+                               frame_last: jnp.ndarray, mesh,
+                               corr_impl: str = "xla", dtype=jnp.float32,
+                               warp_impl: str = "auto") -> jnp.ndarray:
+    """Encode-once flow over a multi-device mesh, frame axis sharded.
+
+    ``frames``: the window's B source frames (B, H, W, 3) sharded on axis 0
+    (B divisible by the mesh size); ``frame_last``: the window's final frame
+    (1, H, W, 3), replicated. Returns (B, H, W, 2) flow for the pairs
+    ``frames[i] → frames[i+1]`` with ``frames[B] := frame_last``, sharded on
+    the pair axis.
+
+    Multi-chip counterpart of :func:`pwc_forward_frames`: the feature
+    pyramid — PWC's dominant stage — runs exactly once per source frame on
+    the shard that owns it; each shard's one cross-shard pair is formed by
+    halo-exchanging the neighbor's first feature map AT EVERY PYRAMID LEVEL
+    (:func:`video_features_tpu.ops.halo.boundary_from_next`, six small ICI
+    messages per shard per step), and only the replicated ``frame_last`` is
+    encoded per-device. Numerics match the pair-split forward up to conv
+    reduction order.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.halo import boundary_from_next, frame_axis_mesh
+
+    b, h, w, _ = frames.shape
+    shard_map, axis, n_dev = frame_axis_mesh(mesh, b)
+    h64, w64 = _grid64(h, w)
+
+    def local(p, fr, fl):  # per-shard: (k, H, W, 3) main + (1, H, W, 3) last
+        x = _preprocess(fr, h64, w64).astype(dtype)
+        xl = _preprocess(fl, h64, w64).astype(dtype)
+        pyr = _pyramid(p["moduleExtractor"], x)      # 6 levels of (k, hl, wl, c)
+        pyr_l = _pyramid(p["moduleExtractor"], xl)   # 6 levels of (1, hl, wl, c)
+        pyr2 = tuple(
+            jnp.concatenate(
+                [lvl[1:], boundary_from_next(lvl[:1], lvl_l, axis, n_dev)],
+                axis=0)
+            for lvl, lvl_l in zip(pyr, pyr_l))
+        return _decode(p, pyr, pyr2, h, w, h64, w64, corr_impl, warp_impl)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(axis), P()), out_specs=P(axis))
+    return fn(params, frames, frame_last)
+
+
 # ---------------------------------------------------------------------------
 # Shapes / random init. conv: (cin, cout, kh, kw); 'T' prefix marks transpose convs
 # whose torch weights are laid out (in, out, kh, kw).

@@ -51,9 +51,11 @@ _VOLUME_HBM_BUDGET = 4 * 1024**3
 def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
                       dtype=jnp.float32, n_devices: int = 1) -> str:
     """Resolve ``auto`` per frame geometry: the reference-default materialized
-    volume while it fits, the O(H·W·D) on-demand MATMUL remat beyond
-    (gather-free; ``VFT_RAFT_ON_DEMAND_IMPL=gather`` reverts to the gather
-    formulation). In fp32 the paths agree to reduction-order ulps (~3e-3 px
+    volume while it fits, the O(H·W·D) on-demand GATHER path beyond
+    (``VFT_RAFT_ON_DEMAND_IMPL=matmul`` opts into the MXU volume remat once a
+    1080p TPU sweep justifies it — its FLOPs scale with frame area, the
+    gather's with the fixed window; see the big-frame comment below). In fp32
+    the paths agree to reduction-order ulps (~3e-3 px
     through 20 iterations, tools/profile_on_demand.py); under
     ``dtype=bfloat16`` the volume path stores a bf16 pyramid while the remat
     rounds the einsum inputs — the same one-bf16-rounding drift class,
@@ -81,13 +83,15 @@ def resolve_corr_impl(corr_impl: str, n_pairs: int, h: int, w: int,
     vol_bytes = per_device_pairs * q * q * itemsize * (1 + 1 / 4 + 1 / 16 + 1 / 64)
     if vol_bytes <= budget:
         return "volume"
-    # past the budget, the gather-free matmul remat is the default: the
-    # gather on-demand path is the measured 40× cliff (scalar-unit bound),
-    # while the remat is the same one-hot/MXU trade that won 15.5× on the
-    # volume lookup — measured 3.2-3.6× faster even on CPU where gathers
-    # are cheap (tools/profile_on_demand.py; TPU confirmation via the same
-    # tool — VFT_RAFT_ON_DEMAND_IMPL=gather reverts if it ever loses there)
-    choice = os.environ.get("VFT_RAFT_ON_DEMAND_IMPL", "matmul")
+    # past the budget, the GATHER formulation is the default (ADVICE r5
+    # revert): the matmul remat's contraction FLOPs per query scale with the
+    # level's hi·wi (quadratic in frame area) while the gather's scale with
+    # the fixed 10×10 window, so the 3.2-3.6× win measured at 64×64 on CPU
+    # can invert by ~300× more remat work at 1080p — exactly the regime auto
+    # selects this path. Flip back to matmul only on a committed 1080p TPU
+    # measurement from tools/profile_on_demand.py
+    # (VFT_RAFT_ON_DEMAND_IMPL=matmul opts in per run meanwhile).
+    choice = os.environ.get("VFT_RAFT_ON_DEMAND_IMPL", "gather")
     if choice not in ("gather", "matmul"):
         # fail loudly like VFT_RAFT_VOLUME_BUDGET does — a typo'd revert
         # that silently stayed on matmul would mislabel a measurement
@@ -448,8 +452,9 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
     outgrows HBM, see :func:`_build_f2_pyramid`; gather-bound, so it trades
     ~40× speed for that memory ceiling); ``on_demand_matmul`` keeps the
     memory ceiling but remats the volume slice per iteration on the MXU
-    instead of gathering (``auto``'s big-frame choice — see
-    :func:`_lookup_on_demand`).
+    instead of gathering (opt-in via ``VFT_RAFT_ON_DEMAND_IMPL=matmul``;
+    ``auto``'s big-frame choice is ``on_demand`` pending a 1080p TPU sweep —
+    see :func:`resolve_corr_impl` and :func:`_lookup_on_demand`).
 
     ``taps``: debug-only dict filled with per-stage activations (fnet/cnet/corr/
     per-iteration flow) for the layer-diff parity harness (tools/layer_diff.py);
@@ -519,6 +524,56 @@ def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
     flow = _refine_flow(params, pairs(feat, True), pairs(feat, False), cnet,
                         iters, None, corr_impl, dtype)
     return flow.reshape(lead[:-1] + (nf - 1, h, w, 2))
+
+
+def raft_forward_frames_sharded(params: Dict, frames: jnp.ndarray,
+                                frame_last: jnp.ndarray, mesh,
+                                iters: int = ITERS, corr_impl: str = "volume",
+                                dtype=jnp.float32) -> jnp.ndarray:
+    """Encode-once flow over a multi-device mesh, frame axis sharded.
+
+    ``frames``: the window's B source frames (B, H, W, 3), sharded on axis 0
+    (B divisible by the mesh size); ``frame_last``: the window's final frame
+    (1, H, W, 3), replicated. Returns (B, H, W, 2) flow for the pairs
+    ``frames[i] → frames[i+1]`` with ``frames[B] := frame_last`` — the flow
+    of the (B+1)-frame window ``[frames; frame_last]``, sharded on the pair
+    axis.
+
+    Multi-chip counterpart of :func:`raft_forward_frames`: the B+1 frames of
+    a window cannot shard evenly, so the pair-split step re-encoded every
+    interior frame twice on meshes > 1 device. Here ``fnet``/``cnet`` run
+    exactly once per source frame on the shard that owns it, each shard's one
+    cross-shard pair is formed by halo-exchanging the NEIGHBOR's first fnet
+    feature map over ICI (:func:`video_features_tpu.ops.halo.
+    boundary_from_next` — one (1, H/8, W/8, 256) message per shard per step),
+    and only the single replicated ``frame_last`` is encoded per-device.
+    Numerics match the pair-split forward up to conv reduction order.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.halo import boundary_from_next, frame_axis_mesh
+
+    b, h, w, _ = frames.shape
+    shard_map, axis, n_dev = frame_axis_mesh(mesh, b)
+    corr_impl = resolve_corr_impl(corr_impl, b, h, w, dtype, n_dev)
+    if corr_impl not in ("volume", "volume_gather", "on_demand", "on_demand_matmul"):
+        raise ValueError(
+            f"corr_impl must be auto|volume|volume_gather|on_demand|"
+            f"on_demand_matmul, got {corr_impl!r}")
+
+    def local(p, fr, fl):  # per-shard: (k, H, W, 3) main + (1, H, W, 3) last
+        x = (2.0 * (fr.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
+        xl = (2.0 * (fl.astype(jnp.float32) / 255.0) - 1.0).astype(dtype)
+        f_loc = _encoder(p["fnet"], x, "instance").astype(jnp.float32)
+        f_extra = _encoder(p["fnet"], xl, "instance").astype(jnp.float32)
+        f_next = boundary_from_next(f_loc[:1], f_extra, axis, n_dev)
+        f2 = jnp.concatenate([f_loc[1:], f_next], axis=0)
+        cnet = _encoder(p["cnet"], x, "batch")  # sources only: no halo needed
+        return _refine_flow(p, f_loc, f2, cnet, iters, None, corr_impl, dtype)
+
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(), P(axis), P()), out_specs=P(axis))
+    return fn(params, frames, frame_last)
 
 
 def _refine_flow(params: Dict, f1: jnp.ndarray, f2: jnp.ndarray, cnet: jnp.ndarray,

@@ -2,6 +2,7 @@ from .mesh import (
     DATA_AXIS,
     MeshRunner,
     batch_sharding,
+    enable_compilation_cache,
     local_mesh,
     replicate,
     sharded_apply,
@@ -13,6 +14,7 @@ __all__ = [
     "DATA_AXIS",
     "MeshRunner",
     "batch_sharding",
+    "enable_compilation_cache",
     "local_mesh",
     "replicate",
     "sharded_apply",

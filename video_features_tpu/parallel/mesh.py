@@ -56,7 +56,8 @@ def replicate(mesh: Mesh) -> NamedSharding:
 
 
 def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
-                  matmul_precision: Optional[str] = None):
+                  matmul_precision: Optional[str] = None,
+                  n_replicated_args: int = 0):
     """jit ``fn(params, *batches)`` with params replicated and batches sharded on axis 0.
 
     Each batch argument's leading axis must be divisible by the mesh size — callers
@@ -69,6 +70,10 @@ def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
     ``matmul_precision``: TPU fp32 convs/matmuls default to bf16 MXU passes;
     ``"highest"`` traces the step under true-fp32 accumulation for the
     bit-parity path (≈3× the matmul cost; irrelevant on CPU).
+
+    ``n_replicated_args``: trailing non-param arguments placed replicated
+    rather than batch-sharded — the encode-once flow steps pass the window's
+    final frame this way (a (1, H, W, 3) array cannot shard over the mesh).
     """
     if matmul_precision is not None:
         inner = fn
@@ -77,8 +82,34 @@ def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
             with jax.default_matmul_precision(matmul_precision):
                 return inner(*args)
 
-    in_shardings = (replicate(mesh),) + (batch_sharding(mesh),) * n_batch_args
+    in_shardings = ((replicate(mesh),)
+                    + (batch_sharding(mesh),) * n_batch_args
+                    + (replicate(mesh),) * n_replicated_args)
     return jax.jit(fn, in_shardings=in_shardings)
+
+
+def enable_compilation_cache(cache_dir: str, min_compile_secs: float = 1.0) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    TPU compiles for large flow geometries cost 20-100 s each (tunnel
+    compiles, docs/budgets.md); a persistent cache directory lets reruns,
+    restarts, and the driver's bench skip straight to execution. Safe to call
+    repeatedly (last directory wins). Returns True when the cache was
+    enabled; a JAX build without the option warns and returns False instead
+    of failing the job.
+    """
+    import os
+    import sys
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", os.path.abspath(cache_dir))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except (AttributeError, ValueError) as e:
+        print(f"warning: could not enable the persistent compilation cache at "
+              f"{cache_dir}: {e}", file=sys.stderr)
+        return False
+    return True
 
 
 class MeshRunner:
@@ -103,8 +134,9 @@ class MeshRunner:
         """Smallest multiple of the mesh size ≥ ``requested``."""
         return -(-requested // self.num_devices) * self.num_devices
 
-    def jit(self, fn: Callable, n_batch_args: int = 1):
-        return sharded_apply(self.mesh, fn, n_batch_args, self.matmul_precision)
+    def jit(self, fn: Callable, n_batch_args: int = 1, n_replicated_args: int = 0):
+        return sharded_apply(self.mesh, fn, n_batch_args, self.matmul_precision,
+                             n_replicated_args)
 
     def put(self, arr):
         """Transfer a host batch onto the mesh, sharded along axis 0."""

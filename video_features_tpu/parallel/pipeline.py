@@ -243,7 +243,10 @@ def prefetch_to_device(
     """Iterate device arrays with ``depth`` transfers in flight.
 
     ``sharding``: optional NamedSharding for the transfer target (mesh-sharded
-    batches); default puts on the default device.
+    batches); default puts on the default device. Items may be pytrees
+    (e.g. the frame-sharded I3D flow step's (frames, last_frame) pairs) with
+    ``sharding`` a matching pytree of shardings — ``jax.device_put`` accepts
+    both.
     """
     if depth < 1:
         raise ValueError("prefetch depth must be >= 1")
