@@ -75,6 +75,7 @@ _FAST_MODULES = {
     "test_resample",
     "test_resnet_extractor",
     "test_spatial",
+    "test_vftlint",
     "test_video_decode",
 }
 

@@ -1,6 +1,7 @@
 """bench.py record-keeping helpers: the stale-headline fallback and baseline
 reader that keep a tunnel outage from sinking the round's bench record
 (BENCH_r03 rc=124, BENCH_r04 rc=1 — the failure mode these exist to end)."""
+# fast-registry: default tier — drives jitted extractor paths; compile-heavy for the fast pre-commit tier
 
 import importlib.util
 import json

@@ -1,5 +1,6 @@
 """Cross-video decode prefetcher: equivalence with inline decode, memory
 bounding, and error isolation through the per-video fault barrier."""
+# fast-registry: default tier — real-sleep concurrency tests on the decode pool
 
 import threading
 import time

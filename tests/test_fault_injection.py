@@ -6,6 +6,7 @@ through the ``VFT_FAULTS`` harness (``reliability/faults.py``) against a
 lightweight frame-stream extractor, plus one real ``run.main`` job for the
 exit-code contract.
 """
+# fast-registry: default tier — e2e extraction under injected faults (compiles)
 
 import os
 import subprocess

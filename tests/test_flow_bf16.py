@@ -9,6 +9,7 @@ px per step (``extract_i3d.py:59-72``), so flow errors well under half a step
 
 CPU runs bf16 in emulation — slow but bit-faithful; shapes stay small.
 """
+# fast-registry: default tier — bf16 drift measurement over flow compiles
 
 import numpy as np
 import pytest

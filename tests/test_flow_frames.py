@@ -7,6 +7,7 @@ pairs must reproduce the pair-split forward, and clip batches must never pair
 across clip boundaries. Kept OUT of the slow-marked parity files so the
 default `pytest` run still covers the production flow path.
 """
+# fast-registry: default tier — shared-frame flow forward parity (flow compiles)
 
 import numpy as np
 

@@ -4,6 +4,7 @@ Covers the production paths a slow-marked file would hide from the default
 run: the spatially tiled Pallas cost-volume kernel (interpret mode) and the
 bf16 TapConv3D lowering every bf16 I3D conv takes.
 """
+# fast-registry: default tier — kernel parity vs torch mirrors
 
 import numpy as np
 

@@ -1,4 +1,5 @@
 """Observability: stage clock semantics and the opt-in per-video report."""
+# fast-registry: default tier — stage-clock tests with real sleeps
 
 import time
 

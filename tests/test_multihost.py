@@ -8,6 +8,7 @@ coordinator (the same code path a TPU pod uses over DCN), then asserts the
 processes agree on the world size and take disjoint, exhaustive, round-robin
 video shards.
 """
+# fast-registry: default tier — loopback two-process jax.distributed init
 
 import json
 import os

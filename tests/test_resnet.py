@@ -1,4 +1,5 @@
 """Flax ResNet-50 numerical parity vs a torch mirror (random weights)."""
+# fast-registry: default tier — resnet50 forward parity (heavy compile)
 
 import os
 import sys

@@ -1,0 +1,553 @@
+"""vftlint: the repo is clean, and every rule both fires and suppresses.
+
+Two layers:
+
+- **tier-1 guard**: the full rule suite over this checkout returns zero
+  findings (any unannotated regression in jit-purity / host-sync /
+  thread-shared-state / explicit-dtype / fault-barrier / fast-registry
+  fails this module);
+- **fixture tests**: per rule, a seeded violation in a tmp tree fires and
+  the annotated/clean form stays quiet — the acceptance contract that no
+  rule is satisfied by blanket allowlisting.
+
+Pure AST work, no jax import, no compiles — registered in _FAST_MODULES.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.vftlint import all_rules, run_lint  # noqa: E402
+from tools.vftlint.__main__ import main as vftlint_main  # noqa: E402
+from tools.vftlint.rules import fast_registry  # noqa: E402
+
+ALL_RULE_IDS = {
+    "explicit-dtype", "fast-registry", "fault-barrier",
+    "host-sync", "jit-purity", "thread-shared-state",
+}
+
+
+def write(root, rel, text):
+    path = root / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(text))
+    return path
+
+
+def lint(root, rule):
+    return [str(f) for f in run_lint(str(root), [rule])]
+
+
+# ---- tier-1 guard ---------------------------------------------------------
+
+
+def test_registry_ships_all_rules():
+    assert set(all_rules()) == ALL_RULE_IDS
+
+
+def test_repo_is_clean():
+    findings = run_lint(REPO)
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_clean_exit(capsys):
+    assert vftlint_main([REPO]) == 0
+    assert "clean" in capsys.readouterr().out
+
+
+def test_cli_unknown_rule_is_usage_error(capsys):
+    assert vftlint_main(["--rule", "no-such-rule", REPO]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
+def test_cli_findings_exit(tmp_path, capsys):
+    write(tmp_path, "video_features_tpu/models/m.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    assert vftlint_main([str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "explicit-dtype" in out and "models/m.py:2" in out
+
+
+def test_cli_list_rules(capsys):
+    assert vftlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ALL_RULE_IDS:
+        assert rule_id in out
+
+
+# ---- jit-purity -----------------------------------------------------------
+
+JIT_IMPURE = """
+    import time
+    import jax
+
+    @jax.jit
+    def step(x):
+        print("tracing", x.shape)
+        t = time.time()
+        return x * t
+"""
+
+JIT_WRAPPED = """
+    class E:
+        def make(self):
+            def step(params, x):
+                import random
+                return x * random.random()
+            return self.runner.jit(step)
+"""
+
+
+def test_jit_purity_fires_on_decorated(tmp_path):
+    write(tmp_path, "video_features_tpu/bad.py", JIT_IMPURE)
+    found = lint(tmp_path, "jit-purity")
+    assert any("'print()'" in f and "bad.py:7" in f for f in found)
+    assert any("time.time" in f for f in found)
+
+
+def test_jit_purity_fires_through_runner_jit(tmp_path):
+    write(tmp_path, "video_features_tpu/bad.py", JIT_WRAPPED)
+    found = lint(tmp_path, "jit-purity")
+    assert any("stdlib 'random.random()'" in f for f in found)
+
+
+def test_jit_purity_fires_through_shard_map(tmp_path):
+    write(tmp_path, "video_features_tpu/bad.py", """
+        def fwd(params, frames, mesh):
+            def local(p, fr):
+                print(fr.shape)
+                return fr
+            return shard_map(local, mesh=mesh)(params, frames)
+    """)
+    assert any("'print()'" in f for f in lint(tmp_path, "jit-purity"))
+
+
+def test_jit_purity_quiet_on_clean_and_untraced(tmp_path):
+    write(tmp_path, "video_features_tpu/ok.py", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * 2
+
+        def host_loop(xs):  # not traced: host effects are fine here
+            for x in xs:
+                print(x)
+    """)
+    assert lint(tmp_path, "jit-purity") == []
+
+
+def test_jit_purity_annotation_suppresses_with_reason(tmp_path):
+    write(tmp_path, "video_features_tpu/ok.py", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            # jit-purity: trace-time banner, deliberately prints once per compile
+            print("compiling")
+            return x
+    """)
+    assert lint(tmp_path, "jit-purity") == []
+
+
+def test_empty_annotation_reason_is_a_finding(tmp_path):
+    write(tmp_path, "video_features_tpu/bad.py", """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("hi")  # jit-purity:
+            return x
+    """)
+    found = lint(tmp_path, "jit-purity")
+    assert any("no reason" in f for f in found)
+    assert any("'print()'" in f for f in found)  # not suppressed either
+
+
+# ---- host-sync ------------------------------------------------------------
+
+HOST_SYNC_BAD = """
+    import numpy as np
+
+    class E:
+        def extract(self, path):
+            feats = self._step(self.params, path)
+            a = np.asarray(feats)
+            b = float(feats)
+            c = feats.item()
+            return a, b, c
+"""
+
+HOST_SYNC_OK = """
+    import numpy as np
+
+    class E:
+        def extract(self, path):
+            feats = self._step(self.params, path)
+            host = self._wait(feats)          # the accounted site
+            meta_fps = np.asarray([25.0])     # host data: not flagged
+            return host, meta_fps
+"""
+
+
+def test_host_sync_fires_on_unaccounted_sinks(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/bad.py", HOST_SYNC_BAD)
+    found = lint(tmp_path, "host-sync")
+    assert any("np.asarray()" in f for f in found)
+    assert any("float()" in f for f in found)
+    assert any(".item()" in f for f in found)
+
+
+def test_host_sync_quiet_when_routed_through_wait(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/ok.py", HOST_SYNC_OK)
+    assert lint(tmp_path, "host-sync") == []
+
+
+def test_host_sync_tracks_params_and_unpacking(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/bad.py", """
+        import numpy as np
+
+        class E:
+            def extract(self, x):
+                feats, logits = self._flow_step(self.params, x)
+                fc = self.params["fc"]
+                a = np.asarray(logits)   # tainted via tuple unpack
+                b = np.asarray(fc["kernel"])  # tainted via *params attr
+                return a @ b
+    """)
+    found = lint(tmp_path, "host-sync")
+    assert len([f for f in found if "np.asarray()" in f]) == 2
+
+
+def test_host_sync_fires_inside_traced_body(tmp_path):
+    write(tmp_path, "video_features_tpu/models/bad.py", """
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            return np.asarray(x) * 2
+    """)
+    assert any("mid-trace" in f for f in lint(tmp_path, "host-sync"))
+
+
+def test_host_sync_branch_rewait_is_not_flagged(tmp_path):
+    """A value re-assigned from _wait INSIDE a branch is host there — the
+    sink check must see the in-branch state, not the pre-block taint."""
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import numpy as np
+
+        class E:
+            def extract(self, x, debug):
+                feats = self._step(self.params, x)
+                if debug:
+                    feats = self._wait(feats)
+                    logits = np.asarray(feats) * 2.0
+                return feats
+    """)
+    assert lint(tmp_path, "host-sync") == []
+
+
+def test_host_sync_else_branch_keeps_pre_branch_taint(tmp_path):
+    """The if-arm's _wait kill must not leak into the else arm."""
+    write(tmp_path, "video_features_tpu/extractors/bad.py", """
+        import numpy as np
+
+        class E:
+            def extract(self, x, debug):
+                feats = self._step(self.params, x)
+                if debug:
+                    feats = self._wait(feats)
+                else:
+                    feats = np.asarray(feats)
+                return feats
+    """)
+    assert any("np.asarray()" in f for f in lint(tmp_path, "host-sync"))
+
+
+def test_host_sync_annotation_suppresses(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import numpy as np
+
+        class E:
+            def warm(self, x):
+                # host-sync: warmup thread, off the critical path
+                np.asarray(self._step(self.params, x))
+    """)
+    assert lint(tmp_path, "host-sync") == []
+
+
+# ---- thread-shared-state --------------------------------------------------
+
+
+def test_thread_rule_fires_on_undeclared_module(tmp_path):
+    write(tmp_path, "video_features_tpu/sneaky.py", """
+        import threading
+
+        def go(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """)
+    found = lint(tmp_path, "thread-shared-state")
+    assert any("no declared threading seam" in f for f in found)
+
+
+def test_thread_rule_fires_on_unannotated_shared_store(tmp_path):
+    # declared module path, declared site — but the annotation is missing
+    write(tmp_path, "video_features_tpu/io/output.py", """
+        import threading
+
+        class W:
+            def start(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                handle = self._q.get()
+                handle._error = ValueError("x")
+    """)
+    found = lint(tmp_path, "thread-shared-state")
+    assert any("without a '# thread-shared-state:" in f for f in found)
+    # declared in SHARED_WRITES, so no 'not declared' finding for this site
+    assert not any("not declared" in f for f in found)
+
+
+def test_thread_rule_fires_on_undeclared_shared_store(tmp_path):
+    write(tmp_path, "video_features_tpu/io/output.py", """
+        import threading
+
+        class W:
+            def start(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                handle = self._q.get()
+                handle._error = 1  # thread-shared-state: before the Event
+                handle._extra = 2  # thread-shared-state: sounds legit
+    """)
+    found = lint(tmp_path, "thread-shared-state")
+    undeclared = [f for f in found if "not declared in SHARED_WRITES" in f]
+    assert len(undeclared) == 1 and "handle._extra" in undeclared[0]
+
+
+def test_thread_rule_exempts_thread_private_objects(tmp_path):
+    """Stores to an object constructed inside the thread entry are
+    thread-private until published — not shared state."""
+    write(tmp_path, "video_features_tpu/io/output.py", """
+        import threading
+
+        class W:
+            def start(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                handle = self._q.get()
+                handle._error = 1  # thread-shared-state: before the Event
+                meta = Thing()
+                meta.count = 0
+                self._q2.put(meta)
+    """)
+    found = lint(tmp_path, "thread-shared-state")
+    assert not any("meta.count" in f for f in found)
+    assert found == []  # handle._error annotated + declared; nothing else
+
+
+def test_thread_rule_empty_annotation_reason_message(tmp_path):
+    """A reasonless annotation reports 'no reason', not 'without a ...
+    annotation' — the developer already wrote the comment."""
+    write(tmp_path, "video_features_tpu/io/output.py", """
+        import threading
+
+        class W:
+            def start(self):
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                handle = self._q.get()
+                handle._error = 1  # thread-shared-state:
+    """)
+    found = lint(tmp_path, "thread-shared-state")
+    assert any("no reason" in f for f in found)
+    assert not any("without a" in f for f in found)
+
+
+def test_thread_rule_reports_stale_declarations(tmp_path):
+    # the declared module spawns a thread whose target stores nothing:
+    # every declared site for it is stale
+    write(tmp_path, "video_features_tpu/io/output.py", """
+        import threading
+
+        def start(fn):
+            threading.Thread(target=fn).start()
+    """)
+    found = lint(tmp_path, "thread-shared-state")
+    assert any("stale declaration" in f and "handle._error" in f
+               for f in found)
+
+
+def test_thread_rule_quiet_on_threadless_module(tmp_path):
+    write(tmp_path, "video_features_tpu/plain.py",
+          "def f(x):\n    return x + 1\n")
+    assert lint(tmp_path, "thread-shared-state") == []
+
+
+# ---- explicit-dtype -------------------------------------------------------
+
+
+def test_explicit_dtype_fires_in_models_and_ops(tmp_path):
+    write(tmp_path, "video_features_tpu/models/m.py", """
+        import jax.numpy as jnp
+        MEAN = jnp.asarray([0.43, 0.39, 0.37])
+        Z = jnp.zeros((3, 3))
+        R = jnp.arange(10)
+    """)
+    found = lint(tmp_path, "explicit-dtype")
+    assert len(found) == 3
+    assert all("explicit-dtype" in f for f in found)
+
+
+def test_explicit_dtype_quiet_on_dtyped_and_like(tmp_path):
+    write(tmp_path, "video_features_tpu/ops/o.py", """
+        import jax.numpy as jnp
+
+        def f(x):
+            a = jnp.asarray([1.0], jnp.float32)       # positional dtype
+            b = jnp.zeros((2, 2), dtype=jnp.int32)    # keyword dtype
+            c = jnp.arange(4, dtype=jnp.int32)
+            d = jnp.zeros_like(x)                     # inherits dtype
+            return a, b, c, d
+    """)
+    assert lint(tmp_path, "explicit-dtype") == []
+
+
+def test_explicit_dtype_out_of_scope_dirs_are_ignored(tmp_path):
+    # host-side code (io/, utils/) may promote freely
+    write(tmp_path, "video_features_tpu/io/h.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    assert lint(tmp_path, "explicit-dtype") == []
+
+
+def test_explicit_dtype_annotation_suppresses(tmp_path):
+    write(tmp_path, "video_features_tpu/models/m.py", """
+        import jax.numpy as jnp
+        # explicit-dtype: promotion wanted — follows the input's dtype knob
+        MEAN = jnp.asarray([0.43])
+    """)
+    assert lint(tmp_path, "explicit-dtype") == []
+
+
+# ---- fault-barrier (migrated rule) ----------------------------------------
+
+
+def test_fault_barrier_rule_fires_via_framework(tmp_path):
+    write(tmp_path, "video_features_tpu/sneaky.py",
+          "try:\n    pass\nexcept Exception:\n    pass\n")
+    found = lint(tmp_path, "fault-barrier")
+    assert any("fault-barrier" in f and "sneaky.py:3" in f for f in found)
+    assert any("no declared barriers" in f for f in found)
+
+
+def test_fault_barrier_rule_quiet_on_clean_tree(tmp_path):
+    write(tmp_path, "video_features_tpu/fine.py",
+          "try:\n    pass\nexcept ValueError:\n    pass\n")
+    assert lint(tmp_path, "fault-barrier") == []
+
+
+def test_shim_still_works():
+    """python tools/lint_fault_barrier.py keeps its PR-1 contract."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint_fault_barrier.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "no strays" in proc.stdout
+
+
+# ---- fast-registry --------------------------------------------------------
+
+
+def _tiered_tree(tmp_path):
+    write(tmp_path, "tests/conftest.py",
+          '_FAST_MODULES = {\n    "test_a",\n}\n')
+    write(tmp_path, "tests/test_a.py", "def test_x():\n    pass\n")
+    write(tmp_path, "tests/test_b.py",
+          "import pytest\npytestmark = pytest.mark.slow\n")
+
+
+def test_fast_registry_quiet_on_tiered_modules(tmp_path, monkeypatch):
+    monkeypatch.setattr(fast_registry, "DEFAULT_TIER", {})
+    _tiered_tree(tmp_path)
+    assert lint(tmp_path, "fast-registry") == []
+
+
+def test_fast_registry_fires_on_untiered_module(tmp_path, monkeypatch):
+    monkeypatch.setattr(fast_registry, "DEFAULT_TIER", {})
+    _tiered_tree(tmp_path)
+    write(tmp_path, "tests/test_c.py", "def test_y():\n    pass\n")
+    found = lint(tmp_path, "fast-registry")
+    assert len(found) == 1 and "'test_c' is in no tier" in found[0]
+
+
+def test_fast_registry_default_tier_needs_annotation(tmp_path, monkeypatch):
+    monkeypatch.setattr(fast_registry, "DEFAULT_TIER",
+                        {"test_c": "mid-weight"})
+    _tiered_tree(tmp_path)
+    write(tmp_path, "tests/test_c.py", "def test_y():\n    pass\n")
+    found = lint(tmp_path, "fast-registry")
+    assert len(found) == 1 and "carries no" in found[0]
+    # the annotated form is quiet
+    write(tmp_path, "tests/test_c.py",
+          "# fast-registry: mid-weight, compiles too heavy for fast\n"
+          "def test_y():\n    pass\n")
+    assert lint(tmp_path, "fast-registry") == []
+
+
+def test_fast_registry_rejects_reasonless_annotation(tmp_path, monkeypatch):
+    monkeypatch.setattr(fast_registry, "DEFAULT_TIER",
+                        {"test_c": "mid-weight"})
+    _tiered_tree(tmp_path)
+    write(tmp_path, "tests/test_c.py",
+          "# fast-registry:\ndef test_y():\n    pass\n")
+    found = lint(tmp_path, "fast-registry")
+    assert len(found) == 1 and "has no reason" in found[0]
+
+
+def test_fast_registry_reports_stale_default_tier_entry(tmp_path, monkeypatch):
+    monkeypatch.setattr(fast_registry, "DEFAULT_TIER", {"test_gone": "x"})
+    _tiered_tree(tmp_path)
+    found = lint(tmp_path, "fast-registry")
+    assert any("no such test module" in f for f in found)
+
+
+def test_fast_registry_missing_conftest(tmp_path):
+    write(tmp_path, "tests/test_a.py", "def test_x():\n    pass\n")
+    found = lint(tmp_path, "fast-registry")
+    assert any("registry is missing" in f for f in found)
+
+
+# ---- framework ------------------------------------------------------------
+
+
+def test_parse_error_is_reported_once(tmp_path):
+    write(tmp_path, "video_features_tpu/broken.py", "def f(:\n")
+    findings = run_lint(str(tmp_path))
+    parse = [f for f in findings if f.rule == "parse-error"]
+    assert len(parse) == 1
+
+
+def test_findings_format():
+    from tools.vftlint import Finding
+
+    f = Finding("pkg/mod.py", 7, "host-sync", "boom")
+    assert str(f) == "pkg/mod.py:7 host-sync boom"
+    assert str(Finding("pkg/mod.py", 0, "r", "m")) == "pkg/mod.py r m"
+
+
+@pytest.mark.parametrize("rule_id", sorted(ALL_RULE_IDS))
+def test_each_rule_runs_standalone_on_repo(rule_id):
+    assert run_lint(REPO, [rule_id]) == []

@@ -1,4 +1,5 @@
 """VGGish DSP frontend golden tests + network parity + postprocessor."""
+# fast-registry: default tier — vggish DSP + forward parity
 
 import importlib.util
 import os

@@ -5,6 +5,7 @@ Formats the reference loads: torch ``.pt``/``.pth`` state_dicts (I3D, RAFT, PWC,
 torchvision ResNet/R21D — some ``module.``-prefixed), a TF-slim checkpoint for
 VGGish (here: its variables dumped to ``.npz``), and this store's own converted
 ``.npz``. Round-trips assert tree equality with direct conversion."""
+# fast-registry: default tier — checkpoint store roundtrips
 
 import os
 import subprocess

@@ -1,4 +1,5 @@
 """Window/slice math vs hand-computed values and reference semantics."""
+# fast-registry: default tier — pre-dates the fast registry; re-tier on the next sweep
 
 import numpy as np
 import pytest

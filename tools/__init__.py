@@ -1,0 +1,3 @@
+# Makes `tools` a regular package so `python -m tools.vftlint` and
+# `from tools.vftlint import ...` resolve without namespace-package ambiguity.
+# The standalone scripts in this directory keep working unchanged.

@@ -1,93 +1,35 @@
 #!/usr/bin/env python
-"""Lint: broad exception catches may exist only at declared fault barriers.
+"""Shim: the fault-barrier lint now lives in the vftlint framework.
 
-The reliability subsystem (``video_features_tpu/reliability``) only works if
-failures reach the per-video barrier *classified* — every new
-``except Exception`` that swallows or blurs an error erodes the taxonomy back
-into the reference's print-and-continue. This check (run as a tier-1 test,
-``tests/test_fault_barrier_lint.py``) enforces two rules over
-``video_features_tpu/``:
-
-1. every ``except Exception`` / ``except BaseException`` / bare ``except:``
-   line must carry a ``fault-barrier:`` comment stating why the broad catch
-   is legitimate there;
-2. the per-file site counts must match the declared allowlist below — adding
-   a new barrier is a deliberate act that edits this file, not a drive-by.
-
-Usage: ``python tools/lint_fault_barrier.py [repo_root]`` → exit 0 clean,
-1 with findings on stderr.
+The PR-1 standalone lint migrated to
+``tools/vftlint/rules/fault_barrier.py`` when the AST framework landed;
+this entry point keeps the original contract byte-for-byte —
+``python tools/lint_fault_barrier.py [repo_root]`` → exit 0 clean, 1 with
+findings on stderr — and re-exports ``scan``/``ALLOWED``/``MARKER``/``BROAD``
+for ``tests/test_fault_barrier_lint.py``. Run the full rule suite with
+``python -m tools.vftlint`` instead.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
-from typing import Dict, List, Tuple
 
-# Declared barriers: package-relative posix path -> expected broad-catch count.
-ALLOWED: Dict[str, int] = {
-    "video_features_tpu/extractors/base.py": 3,    # per-video fault barrier + its async-write reap arm + unwind-path write accounting
-    "video_features_tpu/extractors/flow.py": 3,    # async-copy + imshow probes + precompile warmup
-    "video_features_tpu/io/output.py": 1,          # writer thread: error stored on the WriteHandle
-    "video_features_tpu/parallel/pipeline.py": 2,  # distributed-client probe + worker re-raise
-    "video_features_tpu/reliability/retry.py": 2,  # classified re-raise + attempts attr
-    "video_features_tpu/reliability/watchdog.py": 1,  # hands the exception to the waiter
-    "video_features_tpu/run.py": 1,                # best-effort JAX_PLATFORMS shim
-}
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
 
-MARKER = "fault-barrier:"
-BROAD = re.compile(r"^\s*except\s*(\(\s*)?(Base)?Exception\b|^\s*except\s*:")
-
-
-def scan(repo_root: str) -> Tuple[List[str], Dict[str, int]]:
-    """(findings, per-file broad-catch counts) for the package tree."""
-    findings: List[str] = []
-    counts: Dict[str, int] = {}
-    pkg = os.path.join(repo_root, "video_features_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for name in sorted(filenames):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, start=1):
-                    if not BROAD.match(line):
-                        continue
-                    counts[rel] = counts.get(rel, 0) + 1
-                    if MARKER not in line:
-                        findings.append(
-                            f"{rel}:{lineno}: broad except without a "
-                            f"'{MARKER}' justification comment — raise a "
-                            "classified reliability error instead, or declare "
-                            "the barrier"
-                        )
-    for rel, n in sorted(counts.items()):
-        want = ALLOWED.get(rel)
-        if want is None:
-            findings.append(
-                f"{rel}: {n} broad except(s) in a file with no declared "
-                "barriers — new broad catches must be added to "
-                "tools/lint_fault_barrier.py ALLOWED deliberately"
-            )
-        elif n != want:
-            findings.append(
-                f"{rel}: expected {want} declared barrier(s), found {n} — "
-                "update tools/lint_fault_barrier.py ALLOWED if intentional"
-            )
-    for rel, want in sorted(ALLOWED.items()):
-        if rel not in counts and os.path.exists(os.path.join(repo_root, rel)):
-            findings.append(
-                f"{rel}: allowlist expects {want} barrier(s) but none found — "
-                "prune the stale ALLOWED entry"
-            )
-    return findings, counts
+from tools.vftlint.rules.fault_barrier import (  # noqa: E402,F401
+    ALLOWED,
+    BROAD,
+    MARKER,
+    scan,
+)
 
 
 def main(argv=None) -> int:
     args = list(sys.argv[1:] if argv is None else argv)
-    repo_root = args[0] if args else os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = args[0] if args else _REPO_ROOT
     findings, counts = scan(repo_root)
     if findings:
         for f in findings:

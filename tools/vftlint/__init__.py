@@ -1,0 +1,21 @@
+"""vftlint: AST-based static analysis for the video_features_tpu tree.
+
+Pluggable rule framework (see :mod:`.core`) with shipped rules for the
+invariants the test suite cannot observe: jit-purity, host-sync hygiene,
+thread-shared-state discipline, explicit dtypes in the numeric core, the
+fault-barrier allowlist (migrated from ``tools/lint_fault_barrier.py``), and
+the test-tier fast registry.
+
+CLI: ``python -m tools.vftlint [--rule ID] [root]`` — exit 0 clean, 1 with
+findings, 2 on usage errors. Docs: docs/static-analysis.md.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Rule,
+    SourceFile,
+    all_rules,
+    default_root,
+    register,
+    run_lint,
+)

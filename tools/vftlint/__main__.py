@@ -1,0 +1,51 @@
+"""CLI: ``python -m tools.vftlint [--rule ID ...] [--list-rules] [root]``."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import all_rules, default_root, run_lint
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.vftlint",
+        description="AST static analysis for video_features_tpu "
+                    "(docs/static-analysis.md)")
+    parser.add_argument("root", nargs="?", default=None,
+                        help="repo root to scan (default: this checkout)")
+    parser.add_argument("--rule", action="append", dest="rules", metavar="ID",
+                        help="run only this rule (repeatable)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list registered rules and exit")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code else 0
+
+    registry = all_rules()
+    if args.list_rules:
+        for rule_id in sorted(registry):
+            print(f"{rule_id:22s} {registry[rule_id].title}")
+        return 0
+
+    root = args.root or default_root()
+    try:
+        findings = run_lint(root, args.rules)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    for finding in findings:
+        print(finding)
+    n_rules = len(args.rules) if args.rules else len(registry)
+    if findings:
+        print(f"vftlint: {len(findings)} finding(s) from {n_rules} rule(s)",
+              file=sys.stderr)
+        return 1
+    print(f"vftlint: clean — {n_rules} rule(s) over {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,189 @@
+"""vftlint core: AST sources, findings, the rule registry, and the runner.
+
+The framework generalizes what ``tools/lint_fault_barrier.py`` proved on one
+regex: correctness invariants the test suite cannot observe (a host sync is
+slow, not wrong; a data race loses once a year) are enforced statically, with
+*declared* escapes. Every suppression is an in-code annotation comment
+
+    # <rule-id>: <reason>
+
+on the finding line or the line directly above it — a reasonless annotation is
+itself a finding, so the allowlist grammar cannot rot into blanket waivers.
+
+Rules subclass :class:`Rule` and register with :func:`register`; the runner
+(:func:`run_lint`) walks each rule's declared roots once, shares parsed
+:class:`SourceFile` objects across rules, and returns findings formatted
+``file:line rule-id message``. CLI entry: ``python -m tools.vftlint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple, Type
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path`` is repo-relative posix, ``line`` 1-based (0 =
+    file-level / cross-file, e.g. an allowlist count mismatch)."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc} {self.rule} {self.message}"
+
+
+class SourceFile:
+    """A parsed module: AST + per-line comments (for annotation lookup)."""
+
+    def __init__(self, root: str, rel: str):
+        self.root = root
+        self.rel = rel
+        self.path = os.path.join(root, rel.replace("/", os.sep))
+        with open(self.path, encoding="utf-8") as f:
+            self.text = f.read()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.rel)
+        except SyntaxError as e:
+            self.parse_error = e
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    # last comment on a line wins; lines have at most one anyway
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):
+            pass  # the AST parse error already reports this file
+
+    def annotation(self, rule_id: str, line: int) -> Optional[str]:
+        """Reason text of a ``# <rule-id>: <reason>`` annotation covering
+        ``line`` (same line or the line above). None = not annotated;
+        "" = annotated with an empty reason (invalid — callers report it)."""
+        marker = rule_id + ":"
+        for ln in (line, line - 1):
+            comment = self.comments.get(ln)
+            if comment is None or marker not in comment:
+                continue
+            return comment.split(marker, 1)[1].strip()
+        return None
+
+
+class Rule:
+    """One invariant. Subclasses set ``id``/``title`` and implement
+    :meth:`check_file` (per module) and/or :meth:`finalize` (cross-file,
+    e.g. allowlist count reconciliation). ``roots`` limits the scan."""
+
+    id: str = ""
+    title: str = ""
+    roots: Tuple[str, ...] = ("video_features_tpu",)
+
+    def wants(self, rel: str) -> bool:
+        return rel.endswith(".py")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        return ()
+
+    # -- shared helpers -----------------------------------------------------
+
+    def suppressed(self, src: SourceFile, line: int,
+                   extra: List[Finding]) -> bool:
+        """True if an annotation with a non-empty reason covers ``line``.
+        An empty-reason annotation appends its own finding to ``extra``."""
+        reason = src.annotation(self.id, line)
+        if reason is None:
+            return False
+        if not reason:
+            extra.append(Finding(
+                src.rel, line, self.id,
+                f"'# {self.id}:' annotation has no reason — every "
+                "suppression must say why it is legitimate"))
+            return False
+        return True
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    rule = cls()
+    if not rule.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if rule.id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> Dict[str, Rule]:
+    from . import rules  # noqa: F401 — importing registers the shipped rules
+
+    return dict(_REGISTRY)
+
+
+def _walk_py(root: str, sub: str) -> List[str]:
+    base = os.path.join(root, sub.replace("/", os.sep))
+    rels: List[str] = []
+    if os.path.isfile(base):
+        return [sub]
+    for dirpath, dirnames, filenames in os.walk(base):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, name), root)
+                rels.append(rel.replace(os.sep, "/"))
+    return rels
+
+
+def run_lint(root: str,
+             rule_ids: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected rules (default: all) over ``root``; findings sorted
+    by file/line. Unknown rule ids raise KeyError (the CLI maps it to exit 2)."""
+    registry = all_rules()
+    if rule_ids:
+        missing = [r for r in rule_ids if r not in registry]
+        if missing:
+            raise KeyError(
+                f"unknown rule id(s) {missing}; known: {sorted(registry)}")
+        rules = [registry[r] for r in rule_ids]
+    else:
+        rules = [registry[k] for k in sorted(registry)]
+
+    sources: Dict[str, SourceFile] = {}
+    findings: List[Finding] = []
+    parse_reported = set()
+    for rule in rules:
+        for sub in rule.roots:
+            for rel in _walk_py(root, sub):
+                if not rule.wants(rel):
+                    continue
+                if rel not in sources:
+                    sources[rel] = SourceFile(root, rel)
+                src = sources[rel]
+                if src.parse_error is not None:
+                    if rel not in parse_reported:
+                        parse_reported.add(rel)
+                        findings.append(Finding(
+                            rel, src.parse_error.lineno or 0, "parse-error",
+                            f"cannot parse: {src.parse_error.msg}"))
+                    continue
+                findings.extend(rule.check_file(src))
+        findings.extend(rule.finalize(root))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def default_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))

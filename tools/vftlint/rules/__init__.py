@@ -1,0 +1,13 @@
+"""Shipped rules — importing this package registers them with the core
+registry. New rules: add a module here, subclass ``Rule``, decorate with
+``@register``, and import it below (docs/static-analysis.md walks through
+the full checklist, fixture tests included)."""
+
+from . import (  # noqa: F401
+    explicit_dtype,
+    fast_registry,
+    fault_barrier,
+    host_sync,
+    jit_purity,
+    thread_shared_state,
+)

@@ -1,0 +1,64 @@
+"""explicit-dtype: array creation in models/ and ops/ names its dtype.
+
+``jnp.asarray([0.43, 0.39, 0.37])`` materializes at whatever the promotion
+rules decide at the use site — weak-type promotion has already cost this repo
+two parity hunts (the r21d KINETICS normalize constants among them). Every
+``jnp.array``/``asarray``/``zeros``-family call in the numeric core must pass
+a dtype, positionally or as ``dtype=``; the ``*_like`` constructors inherit
+theirs and are exempt. Suppress a deliberately-promoting site with
+``# explicit-dtype: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Rule, SourceFile, register
+from ..tracing import dotted_name
+
+# constructor -> number of positional args at which dtype is present
+# (asarray(x, dtype) → 2, full(shape, fill, dtype) → 3); None = keyword-only
+# in idiomatic use (arange/linspace/eye positional dtype is buried deep)
+_CREATORS = {
+    "array": 2, "asarray": 2, "zeros": 2, "ones": 2, "empty": 2,
+    "full": 3,
+    "arange": None, "linspace": None, "eye": None,
+}
+# jnp only: host-side np conversions (e.g. PIL decode in ops/image.py) take
+# their dtype from the source buffer, which is correct there
+_MODULES = {"jnp", "jax.numpy"}
+
+
+@register
+class ExplicitDtypeRule(Rule):
+    id = "explicit-dtype"
+    title = "array constructors in the numeric core pass a dtype"
+    roots = ("video_features_tpu/models", "video_features_tpu/ops")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            ctor = node.func.attr
+            if ctor not in _CREATORS:
+                continue
+            base = dotted_name(node.func.value)
+            if base not in _MODULES:
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            dtype_pos = _CREATORS[ctor]
+            if dtype_pos is not None and len(node.args) >= dtype_pos:
+                continue
+            if self.suppressed(src, node.lineno, findings):
+                continue
+            findings.append(Finding(
+                src.rel, node.lineno, self.id,
+                f"{base}.{ctor}() without an explicit dtype — weak-type "
+                "promotion is a parity hazard; pass dtype= (or annotate why "
+                "promotion is wanted here)"))
+        return findings

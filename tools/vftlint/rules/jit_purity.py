@@ -1,0 +1,72 @@
+"""jit-purity: no host side effects inside XLA-traced function bodies.
+
+A ``print``/``time.time``/``datetime.now``/stdlib-``random``/file-I/O call in
+a jitted function runs ONCE, at trace time, then silently never again — the
+classic "my debug print only fired for the first batch" bug — and anything it
+computes is burned into the compiled program as a constant. Host effects
+belong outside the traced region (or behind ``jax.debug.print`` /
+``io_callback``, which this rule deliberately does not match).
+
+Suppress a deliberate trace-time effect with ``# jit-purity: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from ..core import Finding, Rule, SourceFile, register
+from ..tracing import dotted_name, traced_functions, walk_body
+
+# builtins that are host effects wherever they appear in a traced body
+_BANNED_BUILTINS = {"print", "open", "input", "breakpoint"}
+
+# dotted-call suffixes that are host effects; matched against the full
+# callee chain so `jax.random.normal` (fine) never collides with stdlib
+# `random.normal` (banned root below)
+_BANNED_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.process_time",
+    "time.sleep",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "np.save", "np.load", "np.savez", "numpy.save", "numpy.load",
+    "os.remove", "os.replace", "os.rename", "os.unlink", "os.makedirs",
+    "os.mkdir", "os.open", "os.system",
+}
+
+# any call rooted at the stdlib `random` module (random.random, random.seed…)
+_BANNED_ROOTS = {"random"}
+
+
+@register
+class JitPurityRule(Rule):
+    id = "jit-purity"
+    title = "no host side effects inside jitted/shard_mapped functions"
+    roots = ("video_features_tpu",)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in traced_functions(src.tree):
+            for node in walk_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                bad = None
+                if name in _BANNED_BUILTINS:
+                    bad = f"'{name}()'"
+                elif name in _BANNED_CALLS:
+                    bad = f"'{name}()'"
+                elif name.split(".", 1)[0] in _BANNED_ROOTS and "." in name:
+                    bad = f"stdlib '{name}()'"
+                if bad is None:
+                    continue
+                if self.suppressed(src, node.lineno, findings):
+                    continue
+                findings.append(Finding(
+                    src.rel, node.lineno, self.id,
+                    f"{bad} inside traced function '{fn.name}' runs at "
+                    "trace time only — move it out of the jitted region "
+                    "(or use jax.debug / io_callback)"))
+        return findings

@@ -1,0 +1,210 @@
+"""thread-shared-state: cross-thread writes follow a declared discipline.
+
+PR 1/2 introduced five threading seams (async output writer, per-video
+watchdog, decode prefetch pool, flow geometry precompile, fault-injection
+lock) whose safety arguments lived only in comments. This rule turns them
+into checked declarations, the race-detector analogue of the fault-barrier
+allowlist:
+
+1. a module may spawn ``threading.Thread`` only if it is listed in
+   ``THREAD_MODULES`` below — adding a threading seam is a deliberate act
+   that edits this file, not a drive-by;
+2. every store to shared state inside a thread-entry function (the
+   ``target=`` of a ``Thread(...)`` call, nested defs included) — an
+   attribute or subscript whose base is not a thread-local name — must
+   carry a ``# thread-shared-state: <reason>`` annotation naming the
+   lock/Event discipline that publishes it, AND appear in the module's
+   ``SHARED_WRITES`` declaration.
+
+The declared sites and their disciplines:
+
+- ``io/output.py`` ``handle._error``: written by the writer thread strictly
+  before ``handle._done.set()``; readers block on the Event (happens-before).
+- ``parallel/pipeline.py`` ``slot['meta']`` / ``slot['err']``: written by the
+  decode worker strictly before ``slot['ready'].set()`` (err also before the
+  ``_DONE`` sentinel enqueue); consumers wait on the Event / sentinel.
+
+``reliability/watchdog.py`` and ``extractors/flow.py`` spawn threads whose
+targets publish through list-append / Event-set / queue operations only —
+no shared stores to declare.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, register
+from ..tracing import dotted_name
+
+# modules allowed to spawn threads (package-relative posix paths)
+THREAD_MODULES: Dict[str, str] = {
+    "video_features_tpu/io/output.py": "async output writer (single-writer queue)",
+    "video_features_tpu/parallel/pipeline.py": "decode prefetch pool",
+    "video_features_tpu/reliability/watchdog.py": "per-video watchdog worker",
+    "video_features_tpu/extractors/flow.py": "geometry precompile warmup",
+}
+
+# declared cross-thread stores: module -> {canonical site: discipline}
+SHARED_WRITES: Dict[str, Dict[str, str]] = {
+    "video_features_tpu/io/output.py": {
+        "handle._error": "set before _done Event; wait() reads after it",
+    },
+    "video_features_tpu/parallel/pipeline.py": {
+        "slot['meta']": "set before the ready Event",
+        "slot['err']": "set before the ready Event / _DONE sentinel",
+    },
+}
+
+
+def _canonical(target: ast.AST) -> Optional[str]:
+    """'base.attr' / "base['key']" for attribute/subscript store targets whose
+    base is a plain name; None for stores to local names (thread-private)."""
+    if isinstance(target, ast.Attribute):
+        base = dotted_name(target.value)
+        return f"{base}.{target.attr}" if base else None
+    if isinstance(target, ast.Subscript):
+        base = dotted_name(target.value)
+        if base is None:
+            return None
+        key = target.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return f"{base}[{key.value!r}]"
+        return f"{base}[...]"
+    return None
+
+
+def _thread_targets(tree: ast.AST) -> Set[ast.AST]:
+    """FunctionDef nodes used as ``target=`` of a ``Thread(...)`` call."""
+    defs_by_name: Dict[str, List] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    targets: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+        if name != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            if isinstance(kw.value, ast.Name):
+                targets.update(defs_by_name.get(kw.value.id, ()))
+            elif isinstance(kw.value, ast.Attribute):
+                # self._drain → resolve the method by name
+                targets.update(defs_by_name.get(kw.value.attr, ()))
+    return targets
+
+
+@register
+class ThreadSharedStateRule(Rule):
+    id = "thread-shared-state"
+    title = "cross-thread stores follow a declared lock/Event discipline"
+    roots = ("video_features_tpu",)
+
+    def __init__(self) -> None:
+        self._observed: Dict[str, Set[str]] = {}
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        spawns = [n for n in ast.walk(src.tree)
+                  if isinstance(n, ast.Call)
+                  and (dotted_name(n.func) or "").rsplit(".", 1)[-1] == "Thread"]
+        if not spawns:
+            return findings
+        if src.rel not in THREAD_MODULES:
+            for call in spawns:
+                findings.append(Finding(
+                    src.rel, call.lineno, self.id,
+                    "threading.Thread in a module with no declared threading "
+                    "seam — declare it in THREAD_MODULES "
+                    "(tools/vftlint/rules/thread_shared_state.py) and "
+                    "document its shared-state discipline"))
+            return findings
+
+        declared = SHARED_WRITES.get(src.rel, {})
+        observed = self._observed.setdefault(src.rel, set())
+        for fn in _thread_targets(src.tree):
+            for site, node in self._shared_stores(fn):
+                observed.add(site)
+                reason = src.annotation(self.id, node.lineno)
+                if reason is None:
+                    findings.append(Finding(
+                        src.rel, node.lineno, self.id,
+                        f"thread-entry '{fn.name}' stores to shared "
+                        f"{site} without a '# {self.id}: <reason>' "
+                        "annotation naming the lock/Event that publishes it"))
+                elif not reason:
+                    findings.append(Finding(
+                        src.rel, node.lineno, self.id,
+                        f"'# {self.id}:' annotation on the {site} store has "
+                        "no reason — name the lock/Event that publishes it"))
+                if site not in declared:
+                    findings.append(Finding(
+                        src.rel, node.lineno, self.id,
+                        f"shared store {site} in thread-entry '{fn.name}' is "
+                        "not declared in SHARED_WRITES "
+                        "(tools/vftlint/rules/thread_shared_state.py) — "
+                        "declare the discipline deliberately"))
+        return findings
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for rel, sites in SHARED_WRITES.items():
+            if not os.path.exists(os.path.join(root, rel.replace("/", os.sep))):
+                continue
+            for site in sorted(set(sites) - self._observed.get(rel, set())):
+                findings.append(Finding(
+                    rel, 0, self.id,
+                    f"SHARED_WRITES declares {site} but no thread-entry "
+                    "store matches — prune the stale declaration"))
+        self._observed = {}
+        return findings
+
+    @staticmethod
+    def _shared_stores(fn) -> List[Tuple[str, ast.AST]]:
+        """(canonical site, store node) for attribute/subscript stores in the
+        thread target's body, nested defs included (they run on the thread).
+
+        Stores to thread-private objects are exempt: a base name assigned in
+        the target from a *bare-name constructor call* (``meta = Thing()``,
+        ``q = Queue()``) is fresh on this thread until published. Parameters
+        and names from unpacking (``handle, *job = item`` — a queue item IS
+        cross-thread) stay shared; so does a constructed name that is later
+        rebound from a non-fresh source."""
+        private: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            fresh = (isinstance(node.value, ast.Call)
+                     and isinstance(node.value.func, ast.Name))
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    if fresh:
+                        private.add(target.id)
+                    else:
+                        private.discard(target.id)
+        out: List[Tuple[str, ast.AST]] = []
+        for node in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for target in targets:
+                elts = (target.elts
+                        if isinstance(target, (ast.Tuple, ast.List))
+                        else [target])
+                for elt in elts:
+                    site = _canonical(elt)
+                    if site is None:
+                        continue
+                    root = site.split(".", 1)[0].split("[", 1)[0]
+                    if root in private:
+                        continue
+                    out.append((site, node))
+        return out

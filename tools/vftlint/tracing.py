@@ -1,0 +1,106 @@
+"""Shared AST helpers: dotted-name resolution and jit-traced-function discovery.
+
+The jit-purity and host-sync rules both need the set of functions whose bodies
+XLA traces. In this codebase a function becomes traced in one of three ways:
+
+1. decorated with ``jax.jit`` / ``pjit`` / ``functools.partial(jax.jit, ...)``;
+2. passed (first positional argument) to a jit wrapper call —
+   ``jax.jit(fn)``, ``pjit(fn)``, ``shard_map(local, ...)``,
+   ``sharded_apply(mesh, fn, ...)``, or any ``<obj>.jit(fn)`` (the extractors'
+   ``self.runner.jit(step)``);
+3. being a nested ``def`` inside an already-traced function (traced with it).
+
+Detection is name-based, not dataflow-complete — a function smuggled through an
+intermediate variable before wrapping escapes it. That trade is deliberate:
+every wrap site in the tree names its function directly, and the rule exists to
+keep it that way (a finding-free tree stays analyzable).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set, Union
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef]
+
+# callee shapes that trace their function argument
+_JIT_NAMES = {"jit", "pjit", "shard_map", "sharded_apply"}
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_jit_wrapper(callee: ast.AST) -> bool:
+    """Does calling ``callee`` with a function produce a traced function?"""
+    name = dotted_name(callee)
+    if name is None:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last in _JIT_NAMES
+
+
+def _jit_decorated(fn: FunctionNode) -> bool:
+    for dec in fn.decorator_list:
+        if is_jit_wrapper(dec):
+            return True
+        # functools.partial(jax.jit, static_argnames=...)
+        if isinstance(dec, ast.Call):
+            if is_jit_wrapper(dec.func):
+                return True
+            if (dotted_name(dec.func) or "").rsplit(".", 1)[-1] == "partial":
+                if dec.args and is_jit_wrapper(dec.args[0]):
+                    return True
+    return False
+
+
+def traced_functions(tree: ast.AST) -> Set[FunctionNode]:
+    """FunctionDef nodes whose bodies are traced by XLA (ways 1 and 2 above;
+    callers handle 3 by walking the returned nodes' bodies whole)."""
+    # index defs by name; names are near-unique per module here, and a
+    # collision only widens the scan (safe direction for a linter)
+    defs_by_name: dict = {}
+    methods_by_name: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            for node in cls.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods_by_name.setdefault(node.name, []).append(node)
+
+    traced: Set[FunctionNode] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(node):
+                traced.add(node)
+        if not isinstance(node, ast.Call) or not is_jit_wrapper(node.func):
+            continue
+        # the function argument: first positional for <x>.jit/jit/pjit/
+        # shard_map, second for sharded_apply(mesh, fn, ...)
+        callee_last = (dotted_name(node.func) or "").rsplit(".", 1)[-1]
+        arg_idx = 1 if callee_last == "sharded_apply" else 0
+        if len(node.args) <= arg_idx:
+            continue
+        arg = node.args[arg_idx]
+        if isinstance(arg, ast.Name):
+            traced.update(defs_by_name.get(arg.id, ()))
+        elif isinstance(arg, ast.Attribute):
+            # self.runner.jit(self._forward) — resolve by method name
+            traced.update(methods_by_name.get(arg.attr, ()))
+    return traced
+
+
+def walk_body(fn: FunctionNode) -> Iterator[ast.AST]:
+    """Walk a traced function's body including nested defs (traced with it)."""
+    for stmt in fn.body:
+        yield from ast.walk(stmt)

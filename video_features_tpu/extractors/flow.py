@@ -278,6 +278,7 @@ class ExtractFlow(Extractor):
                 import jax
 
                 window = np.zeros((self.batch_size + 1, h, w, 3), np.float32)
+                # host-sync: warmup thread blocks on the zeros window off the critical path by design
                 jax.block_until_ready(self._device_call(window))
             except Exception as e:  # noqa: BLE001 — fault-barrier: best-effort warmup; the real dispatch compiles inline and surfaces any genuine error
                 print(f"[flow] geometry precompile ({h}x{w}) failed: "

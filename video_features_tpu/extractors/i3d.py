@@ -352,7 +352,7 @@ class ExtractI3D(Extractor):
                 feats_dict[stream].append(feats[:valid])
                 self._throttle(feats_dict[stream])
                 if logits is not None:
-                    logits = np.asarray(logits)[:valid]
+                    logits = self._wait(logits)[:valid]
                     for row, logit in enumerate(logits):
                         n_stack = i * self.clips_per_batch + row
                         print(f"{video_path} @ stack {n_stack} ({stream} stream)")

@@ -85,6 +85,12 @@ class ExtractR21D(Extractor):
             tmp_path=self.tmp_dir,
         )
         slices = form_slices(frames.shape[0], self.stack_size, self.step_size)
+        if self.cfg.show_pred:
+            # debug path: fetch the fc head ONCE per video (device_wait-
+            # accounted), not per clip batch
+            fc = self.params["fc"]
+            fc_kernel = self._wait(fc["kernel"])
+            fc_bias = self._wait(fc["bias"])
         vid_feats = []
         for i in range(0, len(slices), self.clips_per_batch):
             chunk = slices[i : i + self.clips_per_batch]
@@ -94,8 +100,7 @@ class ExtractR21D(Extractor):
             feats = self._step(self.params, clips)[: len(chunk)]
             if self.cfg.show_pred:  # debug mode: fetch once, reuse for logits
                 feats = self._wait(feats)
-                fc = self.params["fc"]
-                logits = feats @ np.asarray(fc["kernel"]) + np.asarray(fc["bias"])
+                logits = feats @ fc_kernel + fc_bias
                 for (s, e), row in zip(chunk, logits):
                     print(f"{video_path} @ frames ({s}, {e})")
                     show_predictions_on_dataset(row[None], "kinetics")

@@ -92,6 +92,14 @@ class ExtractResNet50(Extractor):
                 valid_counts.append(len(batch))
                 yield pad_batch(np.stack(batch), self.batch_size)
 
+        if self.cfg.show_pred:
+            # debug path: fetch the fc head ONCE per video (device_wait-
+            # accounted), not per batch — the head is ~8 MB and re-fetching
+            # it every batch was an unaccounted host sync in the step loop
+            fc = self.params["fc"]
+            fc_kernel = self._wait(fc["kernel"])
+            fc_bias = self._wait(fc["bias"])
+
         vid_feats = []
         # decode of batch k+1 overlaps device compute of batch k; the transfer
         # target is the mesh batch sharding, so frames land pre-split per device.
@@ -107,8 +115,7 @@ class ExtractResNet50(Extractor):
             feats = self._step(self.params, device_batch)[: valid_counts[i]]
             if self.cfg.show_pred:  # debug mode: fetch once, reuse for logits
                 feats = self._wait(feats)
-                fc = self.params["fc"]
-                logits = feats @ np.asarray(fc["kernel"]) + np.asarray(fc["bias"])
+                logits = feats @ fc_kernel + fc_bias
                 show_predictions_on_dataset(logits, "imagenet")
             vid_feats.append(feats)
             self._throttle(vid_feats)

@@ -222,7 +222,7 @@ class AsyncOutputWriter:
                 else:
                     self._run_one(*job)
             except Exception as e:  # noqa: BLE001 — fault-barrier: stored on the handle, re-raised classified at the run loop's per-video write reap
-                handle._error = e
+                handle._error = e  # thread-shared-state: set before the _done Event; wait() reads after it
             finally:
                 handle._done.set()
 

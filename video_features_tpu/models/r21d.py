@@ -142,7 +142,8 @@ def r21d_preprocess(frames_u8: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
 
     x = frames_u8.astype(jnp.float32) / 255.0
     x = resize_bilinear_torch(x, *PRE_CROP_SIZE)
-    x = (x - jnp.asarray(KINETICS_MEAN)) / jnp.asarray(KINETICS_STD)
+    x = ((x - jnp.asarray(KINETICS_MEAN, jnp.float32))
+         / jnp.asarray(KINETICS_STD, jnp.float32))
     h, w = x.shape[-3], x.shape[-2]
     i = int(round((h - CROP_SIZE) / 2.0))
     j = int(round((w - CROP_SIZE) / 2.0))

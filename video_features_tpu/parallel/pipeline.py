@@ -152,7 +152,7 @@ class DecodePrefetcher:
                 # time instead of deadlocking the drain — tests prove it
                 fault_point("pool_worker", path)
                 meta, frames = self._open(path)
-                slot["meta"] = meta
+                slot["meta"] = meta  # thread-shared-state: published by the ready Event set below
                 slot["ready"].set()
                 for item in frames:
                     while not stopped():
@@ -164,7 +164,7 @@ class DecodePrefetcher:
                     if stopped():
                         return
             except Exception as e:  # noqa: BLE001 — fault-barrier: re-raised classified at consume time
-                slot["err"] = e
+                slot["err"] = e  # thread-shared-state: published by the ready Event / _DONE sentinel in finally
             finally:
                 slot["ready"].set()
                 while not stopped():
