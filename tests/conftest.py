@@ -70,6 +70,7 @@ _FAST_MODULES = {
     "test_fps_resampler",
     "test_golden_pipeline",
     "test_mirror_independence",
+    "test_packer",
     "test_parallel",
     "test_reliability",
     "test_resample",

@@ -87,6 +87,49 @@ def test_buffer_bound_blocks_worker():
         pool.shutdown()
 
 
+def test_byte_cap_bounds_buffered_frames_tighter_than_count():
+    """Big frames: the byte bound (not the 512-frame count bound) must stop
+    the worker — a mixed 1080p corpus must not pin GBs under the count cap."""
+    produced = []
+    frame = np.zeros((64, 64, 3), np.uint8)  # 12 KB
+
+    def open_big(path):
+        def gen():
+            for i in range(100):
+                produced.append(i)
+                yield frame.copy(), float(i)
+
+        return {"path": path}, gen()
+
+    pool = DecodePrefetcher(open_big, workers=1, max_buffered=512,
+                            max_buffered_bytes=frame.nbytes * 4)
+    pool.schedule("x")
+    try:
+        time.sleep(0.6)  # worker runs ahead until the byte bound stops it
+        assert len(produced) <= 4 + 2  # ~4 frames of budget + one in flight
+        meta, frames = pool.get("x")
+        assert len(list(frames)) == 100  # and everything still arrives
+    finally:
+        pool.shutdown()
+
+
+def test_byte_cap_admits_single_oversized_frame():
+    """A frame larger than the whole byte budget must still flow (an empty
+    buffer always admits one item) — never a livelock."""
+    frame = np.zeros((32, 32, 3), np.uint8)
+
+    def open_one(path):
+        return {"path": path}, iter([(frame, 0.0), (frame, 1.0)])
+
+    pool = DecodePrefetcher(open_one, workers=1, max_buffered_bytes=16)
+    pool.schedule("x")
+    try:
+        meta, frames = pool.get("x")
+        assert len(list(frames)) == 2
+    finally:
+        pool.shutdown()
+
+
 def test_shutdown_joins_threads():
     pool = DecodePrefetcher(_fake_open, workers=2, max_buffered=2)
     for n in (50, 60):

@@ -86,3 +86,73 @@ def test_invalid_args():
         form_slices(10, 0, 1)
     with pytest.raises(ValueError):
         pair_batch_plan(10, 0)
+
+
+# ---- plan invariants the corpus packer relies on (--pack_corpus): every
+# clip yielded exactly once, tails covered or deliberately dropped ----------
+
+
+def test_form_slices_tail_coverage_invariants():
+    for n in range(0, 60):
+        for stack, step in ((4, 4), (4, 2), (5, 3), (16, 16)):
+            slices = form_slices(n, stack, step)
+            # every slice is a full, in-range stack (no short or overrun clip)
+            assert all(e - s == stack and 0 <= s and e <= n for s, e in slices)
+            # starts advance by exactly `step`: no window skipped or duplicated
+            assert [s for s, _ in slices] == [i * step for i in range(len(slices))]
+            # maximality: the NEXT window would overrun the frame count
+            if slices:
+                assert slices[-1][0] + step + stack > n
+            else:
+                assert n < stack
+
+
+def test_frame_batch_plan_partitions_every_frame():
+    for n in range(0, 40):
+        for b in (1, 2, 5):
+            plan = frame_batch_plan(n, b)
+            # exact partition: no frame dropped, none duplicated, order kept
+            assert [i for s, e in plan for i in range(s, e)] == list(range(n))
+            # no range exceeds the batch (the packer's slot budget per dispatch)
+            assert all(0 < e - s <= b for s, e in plan)
+
+
+def test_pair_batch_plan_tail_never_exceeds_batch():
+    for n in range(2, 40):
+        for b in (1, 3, 7):
+            assert all(1 <= e - s <= b for s, e in pair_batch_plan(n, b))
+
+
+# ---- pad_batch edge cases (the packer's corpus-flush padding) --------------
+
+
+def test_pad_batch_full_batch_is_identity():
+    from video_features_tpu.extractors.base import pad_batch
+
+    arr = np.arange(8, dtype=np.uint8).reshape(4, 2)
+    assert pad_batch(arr, 4) is arr  # no copy on the hot full-batch path
+
+
+def test_pad_batch_empty_input_pads_to_all_zeros():
+    from video_features_tpu.extractors.base import pad_batch
+
+    out = pad_batch(np.zeros((0, 3), np.float32), 4)
+    assert out.shape == (4, 3) and out.dtype == np.float32
+    assert not out.any()
+
+
+def test_pad_batch_preserves_rows_and_dtype():
+    from video_features_tpu.extractors.base import pad_batch
+
+    arr = np.arange(6, dtype=np.uint8).reshape(3, 2)
+    padded = pad_batch(arr[:1], 4)
+    assert padded.shape == (4, 2) and padded.dtype == np.uint8
+    np.testing.assert_array_equal(padded[0], arr[0])
+    assert not padded[1:].any()
+
+
+def test_pad_batch_overfull_raises():
+    from video_features_tpu.extractors.base import pad_batch
+
+    with pytest.raises(ValueError, match="exceeds batch_size"):
+        pad_batch(np.zeros((5, 2)), 4)

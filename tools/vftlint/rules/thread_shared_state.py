@@ -23,10 +23,15 @@ The declared sites and their disciplines:
 - ``parallel/pipeline.py`` ``slot['meta']`` / ``slot['err']``: written by the
   decode worker strictly before ``slot['ready'].set()`` (err also before the
   ``_DONE`` sentinel enqueue); consumers wait on the Event / sentinel.
+- ``parallel/pipeline.py`` ``slot['bytes']``: the byte-cap accounting for the
+  decode buffer — incremented by the worker after each enqueue, decremented
+  by the consumer's drain after each dequeue, both under ``slot['lock']``.
 
 ``reliability/watchdog.py`` and ``extractors/flow.py`` spawn threads whose
 targets publish through list-append / Event-set / queue operations only —
-no shared stores to declare.
+no shared stores to declare. ``parallel/packer.py`` (the corpus clip packer)
+spawns NO threads by design: its one consumer thread owns all packing state,
+and its cross-thread traffic rides the pipeline/output seams above.
 """
 
 from __future__ import annotations
@@ -54,6 +59,9 @@ SHARED_WRITES: Dict[str, Dict[str, str]] = {
     "video_features_tpu/parallel/pipeline.py": {
         "slot['meta']": "set before the ready Event",
         "slot['err']": "set before the ready Event / _DONE sentinel",
+        "slot['bytes']": "guarded by slot['lock'] (worker increments after "
+                         "enqueue; the consumer drain decrements after "
+                         "dequeue under the same lock)",
     },
 }
 

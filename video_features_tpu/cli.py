@@ -110,6 +110,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--decode_workers", type=int, default=1,
                         help="background threads decoding upcoming videos while the "
                              "device computes (frame-stream models); 1 = inline")
+    parser.add_argument("--pack_corpus", action="store_true", default=False,
+                        help="corpus-level clip packing: fill every device "
+                             "batch with clips from however many videos are "
+                             "ready instead of zero-padding each video's tail "
+                             "batch (shape-compatible RGB paths: resnet50, "
+                             "r21d_rgb, i3d --streams rgb; others fall back "
+                             "to the per-video loop). Byte-identical features, "
+                             "per-video fault attribution and resume "
+                             "preserved — docs/performance.md")
     parser.add_argument("--shape_bucket", type=int, default=None,
                         help="flow models: replicate-pad frames to multiples of this "
                              "size (multiple of 8) so a mixed-resolution corpus "

@@ -62,6 +62,16 @@ class ExtractionConfig:
     # thread-per-GPU; SPMD centralizes devices, so decode streams are explicit).
     # 1 = inline decode. Frame-stream models only (resnet50, raft, pwc, i3d).
     decode_workers: int = 1
+    # Corpus-level clip packing (--pack_corpus): fill every fixed-shape device
+    # batch with clips from however many videos are ready (the tail batch of
+    # video N packs with the head of video N+1) instead of zero-padding each
+    # video's tail — continuous batching for short-clip corpora
+    # (parallel/packer.py, docs/performance.md). Shape-compatible RGB paths
+    # only (resnet50, r21d_rgb, i3d --streams rgb); flow/audio models and
+    # --show_pred fall back to the per-video loop with a notice. Per-video
+    # fault attribution, resume, retries, and byte-identical features are
+    # preserved; --video_timeout becomes a cooperative per-stream bound.
+    pack_corpus: bool = False
     # Flow-net (RAFT/PWC) conv compute + correlation storage dtype, independent
     # of `dtype` (which governs the feature networks): bfloat16 halves flow-net
     # HBM traffic and MXU passes; correlation ACCUMULATION and coordinate math

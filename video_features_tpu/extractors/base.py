@@ -19,6 +19,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 from ..config import ExtractionConfig, resolve_model_defaults
@@ -36,6 +37,7 @@ from ..parallel.pipeline import DecodePrefetcher
 from ..parallel.mesh import enable_compilation_cache
 from ..reliability import (
     CircuitBreakerTripped,
+    DeviceError,
     RetryPolicy,
     VideoTimeoutError,
     classify,
@@ -86,6 +88,12 @@ class Extractor(abc.ABC):
         self._pending_writes: deque = deque()
         # videos that succeeded in the current run() (failure-manifest pruning)
         self._succeeded: List[str] = []
+        # per-run accounting shared by the per-video and packed loops
+        self._ok = 0
+        self._failures = 0
+        # --pack_corpus occupancy of the last packed run (bench/run.py report):
+        # {"real_slots", "dispatched_slots", "occupancy", "video_clips"}
+        self._pack_stats: Optional[Dict] = None
 
     # --- per-model API ---
 
@@ -96,6 +104,16 @@ class Extractor(abc.ABC):
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         """Per-frame host transform applied during decode (override per model)."""
         return rgb
+
+    def pack_spec(self):
+        """Corpus-packing seam (``--pack_corpus``): a
+        :class:`..parallel.packer.PackSpec` wiring this model's fixed-shape
+        clip stream, jitted device step, and output assembly into the
+        cross-video packer — or None when the model/config has no shape-
+        compatible packing path (flow and audio models; ``--show_pred`` debug
+        runs, whose per-batch prints assume video order). Overridden by the
+        RGB paths (resnet50, r21d_rgb, i3d ``--streams rgb``)."""
+        return None
 
     # --- decode (frame-stream models route through the prefetcher) ---
 
@@ -144,8 +162,6 @@ class Extractor(abc.ABC):
         """
         depth = max(self.cfg.prefetch_depth, 1)
         if len(outputs) > depth:
-            import jax
-
             jax.block_until_ready(outputs[-depth - 1])
 
     # --- shared driver ---
@@ -164,6 +180,14 @@ class Extractor(abc.ABC):
         paths = list(video_paths) if video_paths is not None else self.video_list()
         done = load_done_set(self.output_dir) if self.cfg.resume else set()
         with_metrics = metrics_enabled(self.cfg.profile_dir)
+        pack = None
+        if self.cfg.pack_corpus:
+            pack = self.pack_spec()
+            if pack is None:
+                print(f"--pack_corpus ignored: {self.feature_type} has no "
+                      "shape-compatible packing path under this config "
+                      "(flow/audio models and --show_pred use the per-video "
+                      "loop)")
         workers = self.cfg.decode_workers
         if workers > 1 and self.uses_frame_stream:
             self._decode_pool = DecodePrefetcher(self._open_inline, workers)
@@ -182,7 +206,11 @@ class Extractor(abc.ABC):
                 retry=RetryPolicy(attempts=self.cfg.retries + 1,
                                   base_delay=self.cfg.retry_backoff))
         self._succeeded: List[str] = []  # pruned from the failure manifest at exit
+        self._ok = 0
+        self._failures = 0
         try:
+            if pack is not None:
+                return self._run_packed(pack, paths, done, with_metrics, progress)
             return self._run_loop(paths, done, with_metrics, progress)
         finally:
             # KeyboardInterrupt / a raising progress callback must not leak
@@ -235,9 +263,16 @@ class Extractor(abc.ABC):
         fault_point("extract", path)
         feats_dict = self.extract(path)
         check_cancelled("discarding possibly-partial features")
+        return self._submit_outputs(path, feats_dict, cancelled=cancelled)
+
+    def _submit_outputs(self, path: str, feats_dict: Dict[str, np.ndarray],
+                        cancelled: Optional[threading.Event] = None,
+                        ) -> Optional[WriteHandle]:
+        """One video's output action — shared by the per-video loop's
+        :meth:`_process_one` and the packed loop's finalize."""
         if self._writer is not None:
             # the job carries the cancel event: a timeout landing between
-            # this check and the writer thread picking the job up (or
+            # the caller's check and the writer thread picking the job up (or
             # mid-write) still discards before the done record. This put
             # cannot block on a full queue — the run loop reaps down to one
             # outstanding write before starting the next attempt — so a
@@ -317,8 +352,6 @@ class Extractor(abc.ABC):
         multi-host runs stale records simply remain until a single-host
         ``--retry_failed`` pass clears them.
         """
-        import jax
-
         if not succeeded or jax.process_count() > 1:
             return
         if not os.path.exists(failed_manifest_path(self.output_dir)):
@@ -332,15 +365,69 @@ class Extractor(abc.ABC):
             print(f"warning: could not prune {len(succeeded)} failure "
                   f"record(s): {e}", file=sys.stderr)
 
+    def _fail(self, path: str, e: BaseException) -> None:
+        """Per-video failure accounting — both run loops' barriers and the
+        write reap share it so a write failure is recorded exactly like a
+        compute one (classified, manifested, circuit-breaker counted)."""
+        self._failures += 1
+        err_class, transient = classify(e)
+        attempts = getattr(e, "attempts", 1)
+        # best-effort: the manifest write hitting the same dying
+        # disk as the failure itself must not escape the barrier
+        try:
+            record = record_failure(self.output_dir, path, e, attempts)
+            digest = record["traceback_digest"]
+        except OSError as rec_err:
+            digest = "unrecorded"
+            print(f"warning: could not record failure for {path}: "
+                  f"{rec_err}", file=sys.stderr)
+        print(e)
+        print(f"Extraction failed at: {path} with error (↑). "
+              f"Continuing extraction "
+              f"[{err_class}, transient={transient}, "
+              f"attempts={attempts}, digest={digest}]")
+        if (self.cfg.max_failures is not None
+                and self._failures > self.cfg.max_failures):
+            raise CircuitBreakerTripped(
+                f"{self._failures} videos failed (> --max_failures "
+                f"{self.cfg.max_failures}); aborting — a failure "
+                "rate this high usually has a systemic cause. "
+                "Failures so far are recorded in the failure "
+                "manifest; fix the cause and rerun with "
+                "--retry_failed."
+            ) from e
+
+    def _reap_writes(self, limit: int) -> None:
+        """Resolve oldest pending writes until ≤ ``limit`` remain.
+
+        Peek-then-pop: a KeyboardInterrupt inside ``handle.wait()``
+        (Event.wait is signal-interruptible) must leave the tuple in the
+        deque so the shutdown drain (:meth:`_reap_abandoned_writes`) can
+        still account the write — a popped-then-lost handle would strand
+        its video's stale failure record forever.
+        """
+        pending_writes = self._pending_writes
+        while len(pending_writes) > limit:
+            wpath, handle = pending_writes[0]
+            try:
+                handle.wait()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the write-side arm of the per-video isolation point
+                pending_writes.popleft()
+                self._fail(wpath, e)
+                continue
+            pending_writes.popleft()
+            self._ok += 1
+            self._succeeded.append(wpath)
+
     def _run_loop(self, paths, done, with_metrics, progress) -> int:
         todo = [p for p in paths if os.path.abspath(p) not in done]
         workers = self.cfg.decode_workers
-        ok = 0
         extracted = 0  # excludes resume-skipped videos (throughput honesty)
         resumed = 0  # tracked directly: ok - extracted no longer equals it
         # when an async write fails (extracted counts the successful extract,
-        # ok only counts writes that resolved)
-        failures = 0
+        # self._ok only counts writes that resolved)
         cursor = 0  # decode-window cursor over `todo`
         # async-writer mode: a video counts `ok` only once its write
         # resolved, so the done/failure manifests and the return value agree
@@ -350,67 +437,10 @@ class Extractor(abc.ABC):
         pending_writes.clear()
         t_run = time.perf_counter()
 
-        def fail(path, e) -> None:
-            """Per-video failure accounting — the barrier and the write reap
-            share it so a write failure is recorded exactly like a compute
-            one (classified, manifested, circuit-breaker counted)."""
-            nonlocal failures
-            failures += 1
-            err_class, transient = classify(e)
-            attempts = getattr(e, "attempts", 1)
-            # best-effort: the manifest write hitting the same dying
-            # disk as the failure itself must not escape the barrier
-            try:
-                record = record_failure(self.output_dir, path, e, attempts)
-                digest = record["traceback_digest"]
-            except OSError as rec_err:
-                digest = "unrecorded"
-                print(f"warning: could not record failure for {path}: "
-                      f"{rec_err}", file=sys.stderr)
-            print(e)
-            print(f"Extraction failed at: {path} with error (↑). "
-                  f"Continuing extraction "
-                  f"[{err_class}, transient={transient}, "
-                  f"attempts={attempts}, digest={digest}]")
-            if (self.cfg.max_failures is not None
-                    and failures > self.cfg.max_failures):
-                raise CircuitBreakerTripped(
-                    f"{failures} videos failed (> --max_failures "
-                    f"{self.cfg.max_failures}); aborting — a failure "
-                    "rate this high usually has a systemic cause. "
-                    "Failures so far are recorded in the failure "
-                    "manifest; fix the cause and rerun with "
-                    "--retry_failed."
-                ) from e
-
-        def reap_writes(limit: int) -> None:
-            """Resolve oldest pending writes until ≤ ``limit`` remain.
-
-            Peek-then-pop: a KeyboardInterrupt inside ``handle.wait()``
-            (Event.wait is signal-interruptible) must leave the tuple in the
-            deque so the shutdown drain (:meth:`_reap_abandoned_writes`) can
-            still account the write — a popped-then-lost handle would strand
-            its video's stale failure record forever.
-            """
-            nonlocal ok
-            while len(pending_writes) > limit:
-                wpath, handle = pending_writes[0]
-                try:
-                    handle.wait()
-                except KeyboardInterrupt:
-                    raise
-                except Exception as e:  # noqa: BLE001 — fault-barrier: the write-side arm of the per-video isolation point
-                    pending_writes.popleft()
-                    fail(wpath, e)
-                    continue
-                pending_writes.popleft()
-                ok += 1
-                self._succeeded.append(wpath)
-
         with maybe_profiler(self.cfg.profile_dir):
             for n, path in enumerate(paths, start=1):
                 if os.path.abspath(path) in done:
-                    ok += 1
+                    self._ok += 1
                     resumed += 1
                     if progress:
                         progress(n, len(paths))
@@ -430,12 +460,12 @@ class Extractor(abc.ABC):
                     if handle is not None:
                         pending_writes.append((path, handle))
                     else:
-                        ok += 1
+                        self._ok += 1
                         self._succeeded.append(path)
                 except KeyboardInterrupt:
                     raise
                 except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point
-                    fail(path, e)
+                    self._fail(path, e)
                 finally:
                     self.clock = None
                     if self._decode_pool is not None:
@@ -448,16 +478,170 @@ class Extractor(abc.ABC):
                 # resolve (and be accounted) first. OUTSIDE the barrier: a
                 # CircuitBreakerTripped from the reap must abort the run, not
                 # be swallowed as video `path`'s failure.
-                reap_writes(1)
+                self._reap_writes(1)
                 if progress:
                     progress(n, len(paths))
-            reap_writes(0)  # the tail videos' writes resolve before run() returns
+            self._reap_writes(0)  # tail videos' writes resolve before run() returns
         if with_metrics and extracted:
             dt = time.perf_counter() - t_run
             print(f"extracted {extracted}/{len(paths)} videos "
                   f"({resumed} resumed) in {dt:.2f}s "
                   f"({extracted / dt:.3f} videos/sec)")
-        return ok
+        return self._ok
+
+    def _run_packed(self, spec, paths, done, with_metrics, progress) -> int:
+        """Corpus-level continuous batching (``--pack_corpus``).
+
+        Every fixed-shape device batch is filled with clips from however many
+        videos are ready (the packer holds partial shape queues ACROSS video
+        boundaries — tail of video N packs with head of video N+1) and per-
+        clip results scatter back to per-video assemblies that flush through
+        the shared output path as each video's last clip lands. The per-video
+        invariants of :meth:`_run_loop` are preserved: a poisoned clip stream
+        fails only its contributing video (slot-level attribution), transient
+        failures retry with a fresh decode, resume/done/failure manifests and
+        the circuit breaker behave identically, and per-slot features are
+        byte-identical to the unpacked loop (each slot's row is a pure
+        function of its clip — no cross-sample ops in the packed steps).
+
+        ``--video_timeout`` here bounds a video's *clip stream* cooperatively
+        (checked between clips): with the decode pool active a wedged decode
+        thread still trips it, but a hard-wedged inline decode needs the
+        per-video loop's thread-cancelling watchdog.
+        """
+        from ..parallel.packer import CorpusPacker
+
+        todo = [p for p in paths if os.path.abspath(p) not in done]
+        workers = self.cfg.decode_workers
+        extracted = 0
+        resumed = 0
+        cursor = 0  # decode-window cursor over `todo`
+        self.clock = StageClock() if with_metrics else None  # corpus-level
+        packer = CorpusPacker(spec, wait=self._wait, clock=self.clock)
+        pending_writes = self._pending_writes
+        pending_writes.clear()
+        timeout = self.cfg.video_timeout
+        t_run = time.perf_counter()
+
+        def drain_stream(path: str) -> None:
+            """One attempt at one video: pack every clip of its stream."""
+            deadline = (time.perf_counter() + timeout) if timeout else None
+            fault_point("extract", path)
+            info, clips = spec.open_clips(path)
+            packer.begin(path, info)
+            for clip in clips:
+                packer.add(path, clip)
+                if deadline is not None and time.perf_counter() > deadline:
+                    raise VideoTimeoutError(
+                        f"{path}: packed clip stream exceeded --video_timeout "
+                        f"({timeout:.3g}s); failing this video")
+            packer.finish(path)
+
+        def attempt_with_retries(path: str) -> None:
+            def on_retry(exc, attempt, delay):
+                err_class, _ = classify(exc)
+                print(f"[{err_class}] attempt {attempt} failed for {path}: "
+                      f"{exc}; retrying in {delay:.2g}s")
+                # the retry decodes fresh and repacks from clip 0: the failed
+                # attempt's queued/dispatched slots are orphaned by discard()
+                packer.discard(path)
+                if self._decode_pool is not None:
+                    self._decode_pool.release(path)
+
+            retry_call(
+                lambda: drain_stream(path),
+                RetryPolicy(attempts=self.cfg.retries + 1,
+                            base_delay=self.cfg.retry_backoff),
+                on_retry=on_retry,
+            )
+
+        def emit_completed() -> None:
+            """Finalize every video whose last clip's features have landed."""
+            for asm in packer.pop_completed():
+                try:
+                    feats = spec.finalize(asm.video,
+                                          asm.stacked(spec.empty_row_shape),
+                                          asm.info)
+                    handle = self._submit_outputs(asm.video, feats)
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — fault-barrier: the finalize/write arm of the packed per-video isolation point
+                    self._fail(asm.video, e)
+                    continue
+                if handle is not None:
+                    pending_writes.append((asm.video, handle))
+                else:
+                    self._ok += 1
+                    self._succeeded.append(asm.video)
+            self._reap_writes(1)
+
+        with maybe_profiler(self.cfg.profile_dir):
+            for n, path in enumerate(paths, start=1):
+                if os.path.abspath(path) in done:
+                    self._ok += 1
+                    resumed += 1
+                    if progress:
+                        progress(n, len(paths))
+                    continue
+                if self._decode_pool is not None:
+                    for p in todo[cursor : cursor + workers]:
+                        self._decode_pool.schedule(p)
+                    cursor += 1
+                try:
+                    attempt_with_retries(path)
+                    extracted += 1
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point (packed loop)
+                    packer.discard(path)
+                    self._fail(path, e)
+                finally:
+                    if self._decode_pool is not None:
+                        self._decode_pool.release(path)
+                emit_completed()
+                if progress:
+                    progress(n, len(paths))
+            flush_error = None
+            try:
+                # dispatch partial shape queues (zero-padded tails) and
+                # resolve the final in-flight batch — where tail-batch device
+                # failures actually surface
+                packer.flush()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point
+                flush_error = e
+            emit_completed()
+            for asm in packer.drain_incomplete():
+                # rows lost to a failed co-packed batch (mid-run or at
+                # flush): fail each contributing video so it lands in the
+                # failure manifest (DeviceError is transient — a
+                # --retry_failed pass reprocesses exactly these) instead of
+                # crashing the run or silently denting the return value
+                cause = (f": {flush_error}" if flush_error is not None
+                         else "")
+                self._fail(asm.video, DeviceError(
+                    f"{asm.video}: a co-packed device batch failed before "
+                    f"this video's clips resolved{cause}; rerun with "
+                    "--retry_failed"))
+            self._reap_writes(0)
+        self._pack_stats = {
+            "real_slots": packer.real_slots,
+            "dispatched_slots": packer.dispatched_slots,
+            "occupancy": round(packer.occupancy, 4),
+            "video_clips": dict(packer.video_clips),
+        }
+        if with_metrics:
+            dt = time.perf_counter() - t_run
+            if self.clock is not None:
+                # the stage report carries pack_occupancy; run.py prints the
+                # canonical standalone occupancy line (once) after the run
+                print(self.clock.report(
+                    f"packed corpus ({extracted} videos)", dt))
+            print(f"extracted {extracted}/{len(paths)} videos "
+                  f"({resumed} resumed) in {dt:.2f}s")
+        self.clock = None
+        return self._ok
 
 
 def pad_batch(arr: np.ndarray, batch_size: int) -> np.ndarray:

@@ -295,6 +295,49 @@ class ExtractI3D(Extractor):
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.pre_crop_size)
 
+    def pack_spec(self):
+        """Corpus-packing seam for the rgb-only stream: slots are
+        ``(stack_size + 1, H, W, 3)`` resized stacks, shape-keyed per decoded
+        geometry (the 256-edge resize keys queues by aspect ratio). Flow jobs
+        keep the per-video loop — the flow sandwich's frame-sharded /
+        pair-chunked step geometry is not a fixed-shape packable slot — and
+        two-stream jobs ride with them (both streams consume one batch)."""
+        if self.cfg.show_pred or self.streams != ("rgb",):
+            return None
+        from ..parallel.packer import PackSpec
+
+        def open_clips(path):
+            meta, frames_iter = self._open_video(path)
+            info = {"fps": meta.fps, "timestamps_ms": []}
+
+            def clips():
+                stack: List[np.ndarray] = []
+                for rgb, pos in self._timed_frames(frames_iter):
+                    stack.append(rgb)
+                    if len(stack) - 1 == self.stack_size:
+                        info["timestamps_ms"].append(pos)
+                        yield np.stack(stack)  # (S+1, H, W, 3) uint8
+                        stack = stack[self.step_size :]
+                # trailing partial stack dropped, as in the reference (:216-219)
+
+            return info, clips()
+
+        def step(stacks_u8):
+            feats, _logits = self._rgb_step(self.i3d_params["rgb"],
+                                            self.runner.put(stacks_u8))
+            return feats
+
+        def finalize(path, rows, info):
+            return {
+                "rgb": rows,
+                "fps": np.array(info["fps"]),
+                "timestamps_ms": np.array(info["timestamps_ms"]),
+            }
+
+        return PackSpec(batch_size=self.clips_per_batch,
+                        empty_row_shape=(1024,),
+                        open_clips=open_clips, step=step, finalize=finalize)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = self._open_video(video_path)
         feats_dict: Dict[str, list] = {s: [] for s in self.streams}

@@ -78,6 +78,41 @@ class ExtractR21D(Extractor):
 
         return self.runner.jit(step)
 
+    def pack_spec(self):
+        """Corpus-packing seam: slots are ``(stack, H, W, 3)`` native-
+        resolution slices, shape-keyed per video geometry — same-resolution
+        videos co-pack; a mixed-resolution corpus fills one queue per
+        geometry. Slots are views into the whole-video decode buffer, so a
+        pending tail pins at most ``clips_per_batch - 1`` videos' buffers
+        per geometry until the next same-shape video (or the corpus flush)
+        dispatches them."""
+        if self.cfg.show_pred:
+            return None  # debug path prints per-clip top-5 in video order
+        from ..parallel.packer import PackSpec
+
+        def open_clips(path):
+            _meta, frames, _ts = decode_all(
+                path, extraction_fps=None, tmp_path=self.tmp_dir)
+            slices = form_slices(frames.shape[0], self.stack_size,
+                                 self.step_size)
+
+            def clips():
+                for s, e in slices:
+                    yield frames[s:e]
+
+            return {}, clips()
+
+        def step(clips_u8):
+            return self._step(self.params, self.runner.put(clips_u8))
+
+        def finalize(path, rows, info):
+            # reference returns features only for r21d (extract_r21d.py:123-125)
+            return {self.feature_type: rows}
+
+        return PackSpec(batch_size=self.clips_per_batch,
+                        empty_row_shape=(NUM_FEATURES,),
+                        open_clips=open_clips, step=step, finalize=finalize)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames, _ts = decode_all(
             video_path,

@@ -74,6 +74,41 @@ class ExtractResNet50(Extractor):
         rgb = pil_edge_resize(rgb, RESIZE_SIZE)
         return np_center_crop_hwc(rgb, CENTER_CROP_SIZE, CENTER_CROP_SIZE)
 
+    def pack_spec(self):
+        """Corpus-packing seam: every device slot is one 224² frame, so the
+        whole corpus shares a single shape queue and the tail batch of video
+        N fills with the head of video N+1. Per-row features are byte-
+        identical to the per-video loop: the conv stack has no cross-sample
+        ops and packed batches run the SAME jitted program (same static batch
+        shape as the zero-padded per-video batches)."""
+        if self.cfg.show_pred:
+            return None  # debug path prints per-batch top-5 in video order
+        from ..parallel.packer import PackSpec
+
+        def open_clips(path):
+            meta, frames = self._open_video(path)
+            info = {"fps": meta.fps, "timestamps_ms": []}
+
+            def clips():
+                for rgb, pos in self._timed_frames(frames):
+                    info["timestamps_ms"].append(pos)
+                    yield rgb
+
+            return info, clips()
+
+        def step(frames_u8):
+            return self._step(self.params, self.runner.put(frames_u8))
+
+        def finalize(path, rows, info):
+            return {
+                self.feature_type: rows,
+                "fps": np.array(info["fps"]),
+                "timestamps_ms": np.array(info["timestamps_ms"]),
+            }
+
+        return PackSpec(batch_size=self.batch_size, empty_row_shape=(2048,),
+                        open_clips=open_clips, step=step, finalize=finalize)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames = self._open_video(video_path)
         timestamps_ms = []

@@ -128,6 +128,52 @@ def write_outputs(feats_dict: Mapping[str, np.ndarray], video_path: str,
         mark_done(output_path, video_path, feats_dict.keys())
 
 
+class FeatureAssembly:
+    """Out-of-order per-video feature assembly for the corpus packer.
+
+    With ``--pack_corpus`` a video's clips ride in device batches shared with
+    other videos, so its per-clip feature rows arrive in whatever order those
+    batches dispatch — and videos complete out of submission order (a short
+    video co-packed behind a long one finishes first). This buffer collects
+    rows by clip index and rebuilds the in-order feature array once the clip
+    stream has finished and every reserved row has landed; only then does the
+    run loop hand the assembled output to the (order-preserving) writer.
+    Single-threaded: owned and touched only by the packed run loop's thread.
+    """
+
+    __slots__ = ("video", "info", "expected", "_reserved", "_rows")
+
+    def __init__(self, video: str, info: dict):
+        self.video = video
+        self.info = info  # per-video stream metadata (fps, timestamps, …)
+        self.expected: Optional[int] = None  # clip count, known at finish()
+        self._reserved = 0
+        self._rows: Dict[int, np.ndarray] = {}
+
+    def reserve(self) -> int:
+        """Claim the next clip index (stream order)."""
+        idx = self._reserved
+        self._reserved += 1
+        return idx
+
+    def put(self, idx: int, row: np.ndarray) -> None:
+        self._rows[idx] = row
+
+    def finish(self) -> None:
+        """The clip stream ended cleanly; every reserved row is now expected."""
+        self.expected = self._reserved
+
+    @property
+    def complete(self) -> bool:
+        return self.expected is not None and len(self._rows) == self.expected
+
+    def stacked(self, empty_row_shape, dtype=np.float32) -> np.ndarray:
+        """The video's features in clip order; a typed empty for zero clips."""
+        if not self.expected:
+            return np.zeros((0,) + tuple(empty_row_shape), dtype)
+        return np.stack([self._rows[i] for i in range(self.expected)])
+
+
 class WriteHandle:
     """Completion token for one video's asynchronous output write."""
 

@@ -17,6 +17,7 @@ import collections
 import os
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import jax
@@ -89,6 +90,13 @@ def shard_video_list(
     return list(paths[process_index::process_count])
 
 
+def _item_bytes(item) -> int:
+    """Approximate host bytes of one queued frame item (``(rgb, pos)``)."""
+    if isinstance(item, tuple):
+        return sum(int(getattr(x, "nbytes", 0)) for x in item)
+    return int(getattr(item, "nbytes", 0))
+
+
 class DecodePrefetcher:
     """Cross-video decode parallelism: background threads decode upcoming
     videos while the device chews on the current one.
@@ -101,21 +109,28 @@ class DecodePrefetcher:
     cv2/ffmpeg/PIL release the GIL in their C cores, so threads parallelize.
 
     ``open_fn(path) -> (meta, frames_iter)``; each worker drains one video's
-    iterator into a bounded queue (``max_buffered`` frames — memory cap), and
-    :meth:`get` hands back ``(meta, iterator)`` draining that queue. Paths are
+    iterator into a bounded queue, and :meth:`get` hands back
+    ``(meta, iterator)`` draining that queue. The buffer is bounded TWICE and
+    the tighter bound governs: ``max_buffered`` caps the frame COUNT (the
+    right bound for small frames, where per-item overhead dominates) and
+    ``max_buffered_bytes`` caps the payload BYTES — without it a mixed
+    corpus's 1080p videos (~6 MB/frame) could pin ``workers × 512`` frames
+    ≈ tens of GB of host RAM under the count bound alone. Paths are
     scheduled by the run loop at most ``workers`` ahead of the consume cursor,
-    so total buffered frames stay ≤ workers · max_buffered. Decode errors are
-    re-raised at consume time — the per-video fault barrier sees them exactly
-    as inline decode would.
+    so the totals stay ≤ workers · bound. Decode errors are re-raised at
+    consume time — the per-video fault barrier sees them exactly as inline
+    decode would.
     """
 
     _DONE = object()
 
-    def __init__(self, open_fn: Callable, workers: int, max_buffered: int = 512):
+    def __init__(self, open_fn: Callable, workers: int, max_buffered: int = 512,
+                 max_buffered_bytes: int = 512 << 20):
         if workers < 1:
             raise ValueError("decode workers must be >= 1")
         self._open = open_fn
         self._max = max_buffered
+        self._max_bytes = max_buffered_bytes
         self._slots: dict = {}  # scheduled, not yet consumed
         self._handed: dict = {}  # handed to a consumer via get(), not released
         self._stop = threading.Event()
@@ -131,6 +146,8 @@ class DecodePrefetcher:
             "q": queue.Queue(maxsize=self._max),
             "meta": None,
             "err": None,
+            "bytes": 0,  # buffered payload bytes (max_buffered_bytes bound)
+            "lock": threading.Lock(),  # guards the bytes counter
             "ready": threading.Event(),
             "stop": threading.Event(),  # per-video cancel (release())
         }
@@ -155,9 +172,25 @@ class DecodePrefetcher:
                 slot["meta"] = meta  # thread-shared-state: published by the ready Event set below
                 slot["ready"].set()
                 for item in frames:
+                    nbytes = _item_bytes(item)
+                    # byte bound: wait for buffered-payload room (the frame
+                    # COUNT bound is the queue's maxsize below; the tighter
+                    # of the two governs). An empty buffer always admits one
+                    # item, so a single frame larger than the cap still flows.
+                    while not stopped():
+                        with slot["lock"]:
+                            fits = (slot["bytes"] == 0
+                                    or slot["bytes"] + nbytes <= self._max_bytes)
+                        if fits:
+                            break
+                        time.sleep(0.05)
+                    if stopped():
+                        return
                     while not stopped():
                         try:
                             slot["q"].put(item, timeout=0.2)
+                            with slot["lock"]:
+                                slot["bytes"] += nbytes  # thread-shared-state: guarded by slot['lock'] (consumer decrements under the same lock)
                             break
                         except queue.Full:
                             continue
@@ -208,6 +241,10 @@ class DecodePrefetcher:
                     if slot["err"] is not None:
                         raise slot["err"]
                     return
+                with slot["lock"]:
+                    # release the byte budget as soon as the item leaves the
+                    # buffer (once yielded it is the consumer's memory)
+                    slot["bytes"] -= _item_bytes(item)
                 yield item
 
         return slot["meta"], drain()

@@ -101,6 +101,14 @@ def main(argv=None) -> int:
         print(f"aborted: {e}")
         return 2
     print()
+    # --pack_corpus: how full the dispatched device batches actually were
+    # (real clips / device slots; the per-video loop's tail padding is the
+    # baseline this should beat on short-clip corpora)
+    stats = getattr(extractor, "_pack_stats", None)
+    if stats and stats.get("dispatched_slots"):
+        print(f"packing occupancy: {stats['real_slots']}/"
+              f"{stats['dispatched_slots']} device slots "
+              f"({stats['occupancy']:.1%})")
     failed = len(paths) - ok
     if failed:
         print(f"{failed} video(s) failed; classified records in "

@@ -34,6 +34,13 @@ class StageClock:
     def __init__(self):
         self.seconds: Dict[str, float] = collections.defaultdict(float)
         self.counts: Dict[str, int] = collections.defaultdict(int)
+        # dimensionless counters (no time attached), e.g. the packed loop's
+        # dispatched device slots vs real clips (packing occupancy)
+        self.units: Dict[str, int] = collections.defaultdict(int)
+
+    def add_units(self, name: str, n: int = 1) -> None:
+        """Accumulate a dimensionless counter reported alongside the stages."""
+        self.units[name] += n
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -64,6 +71,12 @@ class StageClock:
             parts.append(f"{name} {self.seconds[name]:.2f}s/{self.counts[name]}")
         accounted = sum(self.seconds.values())
         parts.append(f"overlapped/other {max(wall - accounted, 0.0):.2f}s")
+        for name in sorted(self.units):
+            parts.append(f"{name}={self.units[name]}")
+        if self.units.get("packed_slots"):
+            # packing-occupancy stage: real clips per dispatched device slot
+            occ = self.units["packed_clips"] / self.units["packed_slots"]
+            parts.append(f"pack_occupancy {occ:.1%}")
         return " | ".join(parts)
 
 
