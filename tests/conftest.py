@@ -71,6 +71,7 @@ _FAST_MODULES = {
     "test_golden_pipeline",
     "test_mirror_independence",
     "test_packer",
+    "test_packer_buckets",
     "test_parallel",
     "test_reliability",
     "test_resample",

@@ -1,0 +1,443 @@
+"""Shape-bucket planning and ragged packing (--pack_corpus PR 6): probe
+clustering and the K-cap, smallest-covering bucket lookup, the collate seam's
+partial-consumption contract, anti-starvation flush timing, per-bucket
+occupancy accounting, the decode-starvation heuristic, and — through a tiny
+jitted extractor — the mixed-geometry acceptance path (≤K buckets, a poisoned
+video in a co-packed bucket fails only itself, --retry_failed reprocesses it).
+Real-model packed parity lives in tests/test_packer_models.py."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.extractors.base import Extractor
+from video_features_tpu.io.output import load_done_set
+from video_features_tpu.io.video import probe_geometries
+from video_features_tpu.models.raft import pad_to_shape, unpad
+from video_features_tpu.parallel.packer import (
+    CorpusPacker,
+    PackSpec,
+    ShapeBuckets,
+)
+from video_features_tpu.reliability import load_failures, reset_faults
+from video_features_tpu.utils.metrics import decode_starvation_warning
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+# ---- ShapeBuckets: probe clustering and the K-cap ---------------------------
+
+
+def test_buckets_under_cap_stay_exact():
+    sb = ShapeBuckets([(240, 320), (360, 480)], max_buckets=4)
+    assert sb.buckets == [(240, 320), (360, 480)]
+    assert sb.bucket_for((240, 320)) == (240, 320)
+
+
+def test_buckets_merge_to_cap_by_least_padding():
+    # (240,320)×3 and (264,352)×1 are the cheap merge; (720,1280) stays alone
+    geoms = [(240, 320)] * 3 + [(264, 352), (720, 1280)]
+    sb = ShapeBuckets(geoms, max_buckets=2)
+    assert sb.buckets == [(264, 352), (720, 1280)]
+    assert sb.bucket_for((240, 320)) == (264, 352)
+    assert sb.bucket_for((264, 352)) == (264, 352)
+
+
+def test_buckets_merge_weights_by_video_count():
+    # the union must grow over the POPULAR geometry as cheaply as possible:
+    # merging (100,100)×9 with (110,110) costs 9 videos' padding; merging the
+    # two rare tall/wide shapes costs only their own
+    geoms = [(100, 100)] * 9 + [(110, 90), (90, 110)]
+    sb = ShapeBuckets(geoms, max_buckets=2)
+    assert (100, 100) in sb.buckets
+    assert (110, 110) in sb.buckets
+
+
+def test_bucket_for_picks_smallest_covering_and_adhoc_falls_through():
+    sb = ShapeBuckets([(240, 320), (360, 480)], max_buckets=2)
+    # covered by both → the smaller-area bucket wins
+    assert sb.bucket_for((200, 300)) == (240, 320)
+    # no planned bucket covers (failed probe / surprise geometry): own bucket
+    assert sb.bucket_for((1080, 1920)) == (1080, 1920)
+    # taller than one dim of the small bucket → only the big one covers
+    assert sb.bucket_for((300, 320)) == (360, 480)
+
+
+def test_buckets_cap_validation():
+    with pytest.raises(ValueError):
+        ShapeBuckets([(8, 8)], max_buckets=0)
+
+
+def test_probe_geometries_skips_unprobeable_paths(tmp_path):
+    vid = _write_video(str(tmp_path / "ok.mp4"), 3, (32, 24))
+    bogus = str(tmp_path / "missing.mp4")
+    geoms = probe_geometries([vid, bogus])
+    assert geoms == {vid: (32, 24)}  # (width, height); bogus skipped, not failed
+
+
+def test_pad_to_shape_round_trips_and_rejects_shrink():
+    frames = np.arange(2 * 5 * 7 * 3, dtype=np.uint8).reshape(2, 5, 7, 3)
+    padded, pads = pad_to_shape(frames, (8, 8))
+    assert padded.shape == (2, 8, 8, 3)
+    np.testing.assert_array_equal(unpad(padded, pads), frames)
+    same, pads0 = pad_to_shape(frames, (5, 7))
+    assert same is frames and pads0 == (0, 0, 0, 0)
+    with pytest.raises(ValueError):
+        pad_to_shape(frames, (4, 8))
+
+
+# ---- engine: collate seam ---------------------------------------------------
+
+
+def _sum_step(batch):
+    arr = np.asarray(batch, np.float32)
+    return arr.reshape(arr.shape[0], -1).sum(axis=1, keepdims=True)
+
+
+def test_engine_collate_partial_consumption_and_row_map():
+    """A collate may consume fewer slots than offered; the row map routes
+    each consumed slot to its own output row (flow windows burn a frame
+    position per video boundary — modeled here as 'only 2 slots per batch,
+    read rows in reverse')."""
+    taken = []
+
+    def collate(clips, stream_keys):
+        taken.append([k for k in stream_keys[:2]])
+        batch = np.stack(clips[:2] + clips[:1])  # 3 rows; row 2 is garbage
+        return batch, 2, [1, 0]  # slot 0 ← row 1, slot 1 ← row 0
+
+    spec = PackSpec(batch_size=3, empty_row_shape=(1,), open_clips=None,
+                    step=_sum_step, finalize=None, collate=collate)
+    packer = CorpusPacker(spec, wait=np.asarray)
+    packer.begin("a", {})
+    for v in (1.0, 2.0, 3.0, 4.0):
+        packer.add("a", np.full((2,), v, np.float32))
+    packer.finish("a")
+    packer.flush()
+    (done,) = packer.pop_completed()
+    # row map: slot i fetched row_of[i] — values swap pairwise
+    np.testing.assert_array_equal(
+        done.stacked((1,)), [[4.0], [2.0], [8.0], [6.0]])
+    # continuity keys are (stream_id, clip_idx) with consecutive idx
+    (k0, k1), (k2, k3) = taken
+    assert k0[0] == k1[0] and k1[1] == k0[1] + 1
+    assert k3[1] == k2[1] + 1
+    # occupancy accounting: 4 real slots over 2 dispatches × batch_size 3
+    assert packer.real_slots == 4 and packer.dispatched_slots == 6
+
+
+# ---- engine: anti-starvation flush ------------------------------------------
+
+
+def test_engine_stale_flush_frees_a_rare_bucket_mid_corpus():
+    """flush_age=2: a rare geometry's partial queue dispatches (and its video
+    completes) once two videos finish while it waits — not at corpus end."""
+    packer = CorpusPacker(PackSpec(batch_size=4, empty_row_shape=(1,),
+                                   open_clips=None, step=_sum_step,
+                                   finalize=None),
+                          wait=np.asarray, flush_age=2)
+    packer.begin("rare", {})
+    packer.add("rare", np.ones((3, 3), np.float32))  # lone odd-geometry slot
+    packer.finish("rare")
+    assert packer.pop_completed() == []
+    # two common-geometry videos finish; their batches never fill either
+    for name in ("a", "b"):
+        packer.begin(name, {})
+        packer.add(name, np.ones((2, 2), np.float32))
+        packer.finish(name)
+    done = {a.video for a in packer.pop_completed()}
+    assert "rare" in done  # freed by the age flush, without packer.flush()
+    assert packer.stale_flushes >= 1
+    stats = packer.bucket_stats()
+    assert stats["3x3"]["stale_flushes"] == 1
+    assert stats["3x3"]["real_slots"] == 1
+    assert stats["3x3"]["dispatched_slots"] == 4
+    assert stats["3x3"]["occupancy"] == 0.25
+
+
+def test_engine_active_bucket_is_not_stale_flushed():
+    """A bucket that keeps dispatching is being served: its age resets per
+    dispatch, so a persistent partial remainder does not trigger the flush."""
+    packer = CorpusPacker(PackSpec(batch_size=2, empty_row_shape=(1,),
+                                   open_clips=None, step=_sum_step,
+                                   finalize=None),
+                          wait=np.asarray, flush_age=1)
+    packer.begin("long", {})
+    packer.add("long", np.ones((2,), np.float32))
+    # short videos finish while `long` keeps its queue busy with full batches
+    for i in range(3):
+        packer.begin(f"s{i}", {})
+        packer.add(f"s{i}", np.ones((2,), np.float32))  # fills → dispatch
+        packer.finish(f"s{i}")
+        packer.add("long", np.ones((2,), np.float32))
+    # three videos finished against flush_age=1, yet the shared bucket kept
+    # dispatching full batches — age resets per dispatch, no stale flush
+    assert packer.stale_flushes == 0
+    assert packer.real_slots == packer.dispatched_slots == 6
+    packer.finish("long")
+    packer.flush()
+    assert {a.video for a in packer.pop_completed()} == {
+        "long", "s0", "s1", "s2"}
+
+
+def test_engine_slowly_fed_bucket_is_not_stale_flushed():
+    """A common bucket gaining slots every video is being fed, not stranded:
+    age counts from the last slot arrival, so a corpus of short videos that
+    fills a batch only every several videos never pays a padded mid-corpus
+    flush (the corpus-end-only occupancy is preserved)."""
+    packer = CorpusPacker(PackSpec(batch_size=16, empty_row_shape=(1,),
+                                   open_clips=None, step=_sum_step,
+                                   finalize=None),
+                          wait=np.asarray, flush_age=2)
+    # 8 videos × 3 clips vs batch 16: the single bucket holds a partial
+    # queue across more than flush_age completions between fills
+    for i in range(8):
+        packer.begin(f"v{i}", {})
+        for _ in range(3):
+            packer.add(f"v{i}", np.ones((2, 2), np.float32))
+        packer.finish(f"v{i}")
+    assert packer.stale_flushes == 0
+    assert packer.real_slots == packer.dispatched_slots  # only full batches
+    packer.flush()
+    assert len(packer.pop_completed()) == 8
+
+
+def test_engine_corpus_flush_isolates_failing_bucket():
+    """A device failure dispatching one bucket's corpus-end tail must not
+    abort the other buckets' flush: healthy buckets still resolve, and only
+    the failing bucket's contributors drain incomplete, wearing its cause."""
+    def step(batch):
+        if batch.shape[1:] == (3, 3):
+            raise RuntimeError("dead bucket program")
+        return batch.sum(axis=(1, 2), keepdims=True)[:, 0]
+
+    packer = CorpusPacker(PackSpec(batch_size=4, empty_row_shape=(1,),
+                                   open_clips=None, step=step,
+                                   finalize=None),
+                          wait=np.asarray, flush_age=0)
+    packer.begin("bad", {})
+    packer.add("bad", np.ones((3, 3), np.float32))
+    packer.finish("bad")
+    packer.begin("good", {})
+    packer.add("good", np.ones((2, 2), np.float32))
+    packer.finish("good")
+    packer.flush()  # must not raise: the failure is contained per bucket
+    assert {a.video for a in packer.pop_completed()} == {"good"}
+    (victim,) = packer.drain_incomplete()
+    assert victim.video == "bad"
+    (cause,) = packer.flush_causes("bad")
+    assert "dead bucket program" in cause
+    assert packer.flush_causes("good") == []
+
+
+def test_engine_stale_flush_failure_blames_victims_not_finisher():
+    """A device failure during the anti-starvation flush is contained: the
+    (healthy) video whose finish() triggered it is NOT failed or retried —
+    the flushed bucket's contributors drain incomplete with the cause."""
+    calls = {"n": 0}
+
+    def step(batch):
+        calls["n"] += 1
+        if batch.shape[1:] == (3, 3):  # the rare bucket's program "dies"
+            raise RuntimeError("halt on rare bucket")
+        return batch.sum(axis=(1, 2), keepdims=True)[:, 0]
+
+    packer = CorpusPacker(PackSpec(batch_size=4, empty_row_shape=(1,),
+                                   open_clips=None, step=step,
+                                   finalize=None),
+                          wait=np.asarray, flush_age=2)
+    packer.begin("rare", {})
+    packer.add("rare", np.ones((3, 3), np.float32))
+    packer.finish("rare")  # age 1 < 2: no flush yet
+    packer.begin("ok", {})
+    packer.add("ok", np.ones((2, 2), np.float32))
+    # `ok`'s finish trips the rare bucket's age flush — a batch holding zero
+    # of `ok`'s slots fails, and `ok`'s (healthy) stream must not wear it
+    packer.finish("ok")
+    assert calls["n"] >= 1
+    # causes are attributed per bucket: `rare` wears the failure, the healthy
+    # co-resident video whose finish() merely triggered the flush does not
+    (cause,) = packer.flush_causes("rare")
+    assert "halt on rare bucket" in cause
+    assert packer.flush_causes("ok") == []
+    assert packer.stale_flushes == 0  # the failed attempt is not counted
+    packer.flush()  # corpus end: the healthy bucket still resolves
+    done = {a.video for a in packer.pop_completed()}
+    assert done == {"ok"}
+    (victim,) = packer.drain_incomplete()
+    assert victim.video == "rare"
+
+
+def test_engine_flush_age_zero_keeps_corpus_end_semantics():
+    packer = CorpusPacker(PackSpec(batch_size=4, empty_row_shape=(1,),
+                                   open_clips=None, step=_sum_step,
+                                   finalize=None),
+                          wait=np.asarray, flush_age=0)
+    packer.begin("rare", {})
+    packer.add("rare", np.ones((3, 3), np.float32))
+    packer.finish("rare")
+    for name in ("a", "b", "c", "d"):
+        packer.begin(name, {})
+        packer.finish(name)
+    assert {a.video for a in packer.pop_completed()} == {"a", "b", "c", "d"}
+    packer.flush()  # only the corpus flush frees it
+    assert {a.video for a in packer.pop_completed()} == {"rare"}
+
+
+# ---- decode-starvation heuristic --------------------------------------------
+
+
+def test_decode_starvation_warning_thresholds():
+    assert decode_starvation_warning(0.95, 9.0, 10.0) is None  # well packed
+    assert decode_starvation_warning(0.5, 1.0, 10.0) is None  # not decode-bound
+    msg = decode_starvation_warning(0.5, 6.0, 10.0, stale_flushes=3)
+    assert msg and "--decode_workers" in msg and "3 anti-starvation" in msg
+    assert decode_starvation_warning(0.5, 6.0, 0.0) is None  # degenerate wall
+
+
+# ---- mixed-geometry acceptance: toy extractor over real videos --------------
+
+
+def _write_video(path, frames, size):
+    import cv2
+
+    w = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"), 10.0, size)
+    rng = np.random.default_rng(frames + size[0])
+    for _ in range(frames):
+        w.write(rng.integers(0, 256, (size[1], size[0], 3), dtype=np.uint8))
+    w.release()
+    return str(path)
+
+
+class ToyBucketed(Extractor):
+    """Frame-slot extractor whose PackSpec plans shape buckets from the
+    container probes — the flow extractors' prepare/open_clips wiring with a
+    one-compile jitted step (mean/max per frame, geometry-independent after
+    the bucket pad)."""
+
+    uses_frame_stream = True
+    BATCH = 4
+    K = 2
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+
+        def fwd(params, frames_u8):
+            x = frames_u8.astype(jnp.float32)
+            return jnp.stack([x.mean(axis=(1, 2, 3)), x.max(axis=(1, 2, 3))],
+                             axis=-1)
+
+        self._step = self.runner.jit(fwd)
+        self._params = self.runner.put_replicated(
+            {"w": np.zeros((1,), np.float32)})
+        self._buckets = None
+
+    def extract(self, video_path):  # per-video loop unused in these tests
+        raise NotImplementedError
+
+    def pack_spec(self):
+        def prepare(paths):
+            geoms = [(h, w) for w, h in probe_geometries(paths).values()]
+            self._buckets = ShapeBuckets(geoms, self.K) if geoms else None
+
+        def open_clips(path):
+            meta, frames = self._open_video(path)
+            bucket = (self._buckets.bucket_for((meta.height, meta.width))
+                      if self._buckets is not None
+                      else (meta.height, meta.width))
+            info = {"timestamps_ms": []}
+
+            def clips():
+                for rgb, pos in self._timed_frames(frames):
+                    info["timestamps_ms"].append(pos)
+                    yield pad_to_shape(rgb, bucket)[0]
+
+            return info, clips()
+
+        def step(batch):
+            return self._step(self._params, self.runner.put(batch))
+
+        def finalize(path, rows, info):
+            return {"feat": rows,
+                    "timestamps_ms": np.array(info["timestamps_ms"])}
+
+        return PackSpec(batch_size=self.BATCH, empty_row_shape=(2,),
+                        open_clips=open_clips, step=step, finalize=finalize,
+                        prepare=prepare)
+
+
+@pytest.fixture(scope="module")
+def mixed_corpus(tmp_path_factory):
+    """Five videos over three geometries: 32×24 (common), 24×16 (merges into
+    the 32×24 bucket under K=2), 64×48 (its own bucket)."""
+    d = tmp_path_factory.mktemp("mixed")
+    return [_write_video(d / "a0.mp4", 5, (32, 24)),
+            _write_video(d / "a1.mp4", 3, (24, 16)),
+            _write_video(d / "a2.mp4", 6, (32, 24)),
+            _write_video(d / "b0.mp4", 4, (64, 48)),
+            _write_video(d / "b1.mp4", 2, (64, 48))]
+
+
+def _cfg(tmp_path, sub, **kw):
+    kw.setdefault("retries", 0)
+    kw.setdefault("retry_backoff", 0.01)
+    return ExtractionConfig(
+        feature_type="resnet50", on_extraction="save_numpy", num_devices=1,
+        pack_corpus=True, output_path=str(tmp_path / sub),
+        tmp_path=str(tmp_path / "t"), **kw)
+
+
+def test_mixed_geometry_corpus_packs_into_at_most_k_buckets(
+        tmp_path, mixed_corpus):
+    ex = ToyBucketed(_cfg(tmp_path, "m"))
+    assert ex.run(mixed_corpus) == len(mixed_corpus)
+    stats = ex._pack_stats
+    buckets = stats["buckets"]
+    # 3 probed geometries clustered into ≤K=2 slot shapes, each with its own
+    # measured occupancy
+    assert len(buckets) <= ToyBucketed.K
+    assert set(buckets) == {"24x32x3", "48x64x3"}
+    for b in buckets.values():
+        assert b["dispatched_slots"] >= b["real_slots"] > 0
+        assert 0.0 < b["occupancy"] <= 1.0
+    # per-bucket totals reconcile with the corpus totals
+    assert sum(b["real_slots"] for b in buckets.values()) == stats["real_slots"]
+    assert (sum(b["dispatched_slots"] for b in buckets.values())
+            == stats["dispatched_slots"])
+    # the merged 24×16 video decodes 3 frames into the 24x32 bucket
+    assert stats["video_clips"][mixed_corpus[1]] == 3
+    feats = np.load(str(tmp_path / "m" / "resnet50" / "a1_feat.npy"))
+    assert feats.shape == (3, 2)
+
+
+def test_poisoned_video_in_a_co_packed_bucket_fails_only_itself(
+        tmp_path, mixed_corpus, monkeypatch):
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:a2")
+    ex = ToyBucketed(_cfg(tmp_path, "pz"))
+    assert ex.run(mixed_corpus) == len(mixed_corpus) - 1
+    failures = load_failures(ex.output_dir)
+    assert set(failures) == {os.path.abspath(mixed_corpus[2])}
+    assert len(load_done_set(ex.output_dir)) == len(mixed_corpus) - 1
+    # co-packed bucket neighbours completed with full outputs
+    ok = {os.path.basename(p)
+          for p in glob.glob(str(tmp_path / "pz" / "resnet50" / "*_feat.npy"))}
+    assert ok == {"a0_feat.npy", "a1_feat.npy", "b0_feat.npy", "b1_feat.npy"}
+
+    # --retry_failed semantics: reprocess exactly the manifest set
+    monkeypatch.delenv("VFT_FAULTS")
+    reset_faults()
+    failed = sorted(load_failures(ex.output_dir))
+    assert ex.run(failed) == 1
+    assert load_failures(ex.output_dir) == {}
+    assert len(load_done_set(ex.output_dir)) == len(mixed_corpus)

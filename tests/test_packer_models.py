@@ -1,6 +1,7 @@
 """Real-model packed parity: --pack_corpus over a mixed-length corpus must
 produce byte-identical .npy outputs to the per-video loop through the
-production ResNet-50 / R(2+1)D / I3D-rgb device steps.
+production ResNet-50 / R(2+1)D / I3D (rgb + pwc flow sandwich) / RAFT dense
+flow / VGGish device steps.
 
 Budget discipline: each test builds ONE extractor (random weights, tiny
 geometry) and runs both loops through the SAME instance — the packed batches
@@ -106,14 +107,82 @@ def test_i3d_rgb_packed_parity(tmp_path):
     assert ex._pack_stats["dispatched_slots"] == 4
 
 
-def test_i3d_two_stream_has_no_pack_path(tmp_path):
-    """Flow-bearing configs must fall back (pack_spec is None) — asserted at
-    the config seam without building the flow nets."""
+def test_raft_packed_parity(tmp_path):
+    """Dense-flow packing through the collate seam: frame-pair slots chained
+    back into shared-frame windows must reproduce the per-video loop's bytes
+    (each pair's flow is a pure function of its two frames under the one
+    jitted program both loops dispatch)."""
+    from video_features_tpu.extractors.flow import ExtractFlow
+
+    corpus = [_write_video(tmp_path / f"v{i}.mp4", n)
+              for i, n in enumerate((4, 3, 6))]
+    ex = ExtractFlow(_cfg(tmp_path, feature_type="raft", batch_size=2))
+    ex = _both_runs(ex, tmp_path, corpus, "raft")
+    # pairs 3+2+5 = 10 over 2-pair windows → 5 full + 1 padded at flush
+    assert ex._pack_stats["real_slots"] == 10
+    assert ex._pack_stats["dispatched_slots"] == 12
+    # single geometry: one bucket, keyed by the (2, H, W, 3) pair-slot shape
+    assert list(ex._pack_stats["buckets"]) == ["2x24x32x3"]
+
+
+def test_i3d_two_stream_pwc_sandwich_packed_parity(tmp_path):
+    """The i3d flow sandwich packs as self-contained stack slots, and a
+    two-stream job feeds both streams from one co-packed device batch —
+    byte-identical to the per-video loop for both output keys."""
+    from video_features_tpu.extractors.i3d import ExtractI3D
+
+    corpus = [_write_video(tmp_path / f"v{i}.mp4", n)
+              for i, n in enumerate((17, 18, 34))]
+    ex = ExtractI3D(_cfg(tmp_path, feature_type="i3d",
+                         streams=("rgb", "flow"), flow_type="pwc",
+                         stack_size=16, step_size=16, clips_per_batch=2,
+                         i3d_pre_crop_size=64, i3d_crop_size=32))
+    ex = _both_runs(ex, tmp_path, corpus, "i3d")
+    # stacks 1+1+2 = 4 over batch 2 → 4 slots packed vs 6 unpacked
+    assert ex._pack_stats["real_slots"] == 4
+    assert ex._pack_stats["dispatched_slots"] == 4
+
+
+def test_vggish_packed_parity(tmp_path):
+    """Audio packs as fixed (96, 64) log-mel slabs — the corpus shares one
+    shape queue and embeddings match the per-video loop bit for bit."""
+    from scipy.io import wavfile
+
+    from video_features_tpu.extractors.vggish import ExtractVGGish
+
+    rng = np.random.default_rng(0)
+    corpus = []
+    for i, secs in enumerate((2.5, 1.2, 4.0)):
+        p = str(tmp_path / f"a{i}.wav")
+        wav = (rng.uniform(-0.5, 0.5, int(16000 * secs)) * 32767).astype(np.int16)
+        wavfile.write(p, 16000, wav)
+        corpus.append(p)
+    ex = ExtractVGGish(_cfg(tmp_path, feature_type="vggish"))
+    ex = _both_runs(ex, tmp_path, corpus, "vggish")
+    # 2+1+4 = 7 examples pack into one 32-slot batch at corpus flush (the
+    # per-video loop dispatches three padded batches = 96 slots)
+    assert ex._pack_stats["real_slots"] == 7
+    assert ex._pack_stats["dispatched_slots"] == 32
+
+
+def test_pack_seam_fallbacks(tmp_path):
+    """The only per-video fallbacks left: --show_pred (both extractors) and
+    the single-clip frame-sharded flow sandwich — asserted at the config seam
+    without building models."""
+    from video_features_tpu.extractors.flow import ExtractFlow
     from video_features_tpu.extractors.i3d import ExtractI3D
 
     ex = ExtractI3D.__new__(ExtractI3D)  # seam check only: no weights/compile
     ex.streams = ("rgb", "flow")
+    ex.clips_per_batch = 2
     ex.cfg = _cfg(tmp_path, feature_type="i3d")
+    ex._flow_frame_sharded = True  # one clip fills the mesh: nothing to pack
     assert ex.pack_spec() is None
-    ex.streams = ("flow",)
+    ex._flow_frame_sharded = False
+    assert ex.pack_spec() is not None  # two-stream packs now
+    ex.cfg = ex.cfg.replace(show_pred=True)
     assert ex.pack_spec() is None
+
+    fx = ExtractFlow.__new__(ExtractFlow)
+    fx.cfg = _cfg(tmp_path, feature_type="raft", show_pred=True)
+    assert fx.pack_spec() is None

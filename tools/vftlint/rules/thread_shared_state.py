@@ -49,6 +49,10 @@ THREAD_MODULES: Dict[str, str] = {
     "video_features_tpu/parallel/pipeline.py": "decode prefetch pool",
     "video_features_tpu/reliability/watchdog.py": "per-video watchdog worker",
     "video_features_tpu/extractors/flow.py": "geometry precompile warmup",
+    # ThreadPoolExecutor (not a bare Thread(...), so the spawn scan does not
+    # see it) — declared here anyway per this rule's contract: workers return
+    # values only, assembly happens on the calling thread, no shared stores
+    "video_features_tpu/io/video.py": "corpus geometry probe pool (prepare)",
 }
 
 # declared cross-thread stores: module -> {canonical site: discipline}

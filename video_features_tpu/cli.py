@@ -114,11 +114,31 @@ def build_parser() -> argparse.ArgumentParser:
                         help="corpus-level clip packing: fill every device "
                              "batch with clips from however many videos are "
                              "ready instead of zero-padding each video's tail "
-                             "batch (shape-compatible RGB paths: resnet50, "
-                             "r21d_rgb, i3d --streams rgb; others fall back "
-                             "to the per-video loop). Byte-identical features, "
-                             "per-video fault attribution and resume "
-                             "preserved — docs/performance.md")
+                             "batch. Every feature type packs (RGB stacks, "
+                             "flow frame-pairs, i3d sandwich stacks, vggish "
+                             "log-mel slabs; flow models bucket mixed "
+                             "geometries via --pack_buckets, other models "
+                             "queue per decoded shape) — the per-video "
+                             "fallbacks are "
+                             "--show_pred and the single-clip frame-sharded "
+                             "flow sandwich, each with a printed notice. "
+                             "Per-video fault attribution and resume "
+                             "preserved; features are byte-identical to the "
+                             "per-video loop except where a merged flow "
+                             "bucket pads frames (--pack_buckets border "
+                             "caveat) — docs/performance.md")
+    parser.add_argument("--pack_buckets", type=int, default=4,
+                        help="--pack_corpus flow models: cluster the corpus's "
+                             "probed geometries into at most this many padded "
+                             "shape buckets (one compiled program each) "
+                             "before decode starts; merged buckets carry "
+                             "--shape_bucket's border-perturbation caveat")
+    parser.add_argument("--pack_flush_age", type=int, default=8,
+                        help="--pack_corpus anti-starvation flush: dispatch a "
+                             "bucket's partial queue once this many videos "
+                             "finished while it waited, so a rare geometry "
+                             "cannot strand its videos until corpus end "
+                             "(0 = flush only at corpus end)")
     parser.add_argument("--shape_bucket", type=int, default=None,
                         help="flow models: replicate-pad frames to multiples of this "
                              "size (multiple of 8) so a mixed-resolution corpus "

@@ -66,12 +66,34 @@ class ExtractionConfig:
     # batch with clips from however many videos are ready (the tail batch of
     # video N packs with the head of video N+1) instead of zero-padding each
     # video's tail — continuous batching for short-clip corpora
-    # (parallel/packer.py, docs/performance.md). Shape-compatible RGB paths
-    # only (resnet50, r21d_rgb, i3d --streams rgb); flow/audio models and
-    # --show_pred fall back to the per-video loop with a notice. Per-video
-    # fault attribution, resume, retries, and byte-identical features are
-    # preserved; --video_timeout becomes a cooperative per-stream bound.
+    # (parallel/packer.py, docs/performance.md). Every extractor packs: the
+    # RGB paths (resnet50, r21d_rgb, i3d) pack stacked clip slots, the flow
+    # extractors (raft/pwc and the i3d flow sandwich) pack frame-pair /
+    # sandwich-stack slots, vggish packs fixed log-mel slabs, and mixed
+    # geometries pack into ≤ pack_buckets padded shape buckets. The one
+    # documented per-video fallback is --show_pred (its per-batch prints
+    # assume video order; a notice is printed), plus the single-clip
+    # frame-sharded flow sandwich, where one clip already fills the mesh.
+    # Per-video fault attribution, resume, and retries are preserved, and
+    # features are byte-identical to the per-video loop EXCEPT where a
+    # merged flow bucket replicate-pads frames (the pack_buckets /
+    # --shape_bucket border caveat; single-geometry corpora always match);
+    # --video_timeout becomes a cooperative per-stream bound.
     pack_corpus: bool = False
+    # --pack_corpus, flow extractors: cluster the corpus's probed (padded)
+    # geometries into at most this many shape buckets before decode starts
+    # (parallel/packer.py ShapeBuckets) — a mixed-resolution corpus compiles
+    # ≤ K programs and co-packs inside each bucket instead of filling one
+    # queue per distinct geometry. Merged buckets replicate-pad frames up to
+    # the bucket geometry, which carries --shape_bucket's documented
+    # border-perturbation caveat; single-geometry corpora are unaffected.
+    pack_buckets: int = 4
+    # --pack_corpus anti-starvation flush: dispatch a shape bucket's partial
+    # queue (zero-padded) once this many videos have finished while it sat
+    # waiting, so a rare geometry cannot strand its videos until corpus end.
+    # Trades padding (occupancy) for latency on rare buckets; 0 disables
+    # (partial queues then flush only at corpus end, the PR 4 behavior).
+    pack_flush_age: int = 8
     # Flow-net (RAFT/PWC) conv compute + correlation storage dtype, independent
     # of `dtype` (which governs the feature networks): bfloat16 halves flow-net
     # HBM traffic and MXU passes; correlation ACCUMULATION and coordinate math
@@ -219,6 +241,11 @@ class ExtractionConfig:
             raise ValueError("matmul_precision must be default|high|highest")
         if self.decode_workers < 1:
             raise ValueError("decode_workers must be >= 1")
+        if self.pack_buckets < 1:
+            raise ValueError("pack_buckets must be >= 1")
+        if self.pack_flush_age < 0:
+            raise ValueError("pack_flush_age must be >= 0 (0 = flush only at "
+                             "corpus end)")
         if self.retries < 0:
             raise ValueError("retries must be >= 0")
         if self.retry_backoff < 0:

@@ -48,7 +48,12 @@ from ..reliability import (
     retry_call,
     run_with_timeout,
 )
-from ..utils.metrics import StageClock, maybe_profiler, metrics_enabled
+from ..utils.metrics import (
+    StageClock,
+    decode_starvation_warning,
+    maybe_profiler,
+    metrics_enabled,
+)
 
 
 class Extractor(abc.ABC):
@@ -109,10 +114,13 @@ class Extractor(abc.ABC):
         """Corpus-packing seam (``--pack_corpus``): a
         :class:`..parallel.packer.PackSpec` wiring this model's fixed-shape
         clip stream, jitted device step, and output assembly into the
-        cross-video packer — or None when the model/config has no shape-
-        compatible packing path (flow and audio models; ``--show_pred`` debug
-        runs, whose per-batch prints assume video order). Overridden by the
-        RGB paths (resnet50, r21d_rgb, i3d ``--streams rgb``)."""
+        cross-video packer — or None when the config has no packing path.
+        Every extractor packs: RGB paths (resnet50, r21d_rgb, i3d) use
+        stacked clip slots, the flow extractors pack frame-pair slots through
+        the collate seam into shared-frame windows, and vggish packs fixed
+        log-mel slabs. The remaining per-video fallbacks are ``--show_pred``
+        debug runs (per-batch prints assume video order) and the single-clip
+        frame-sharded flow sandwich (one clip already fills the mesh)."""
         return None
 
     # --- decode (frame-stream models route through the prefetcher) ---
@@ -139,10 +147,13 @@ class Extractor(abc.ABC):
     # --- observability hooks (no-ops unless metrics are enabled) ---
 
     def _timed_frames(self, frames_iter):
-        """Attribute host time blocked on decode/transform to the 'decode' stage."""
+        """Attribute host time blocked on decode/transform to the 'decode'
+        stage, and account decoded payload bytes (the ingest-throughput
+        counter the stage report derives decode MB/s from)."""
         if self.clock is None:
             return frames_iter
-        return self.clock.timed_iter(frames_iter, "decode")
+        return self.clock.timed_iter(frames_iter, "decode",
+                                     bytes_of=lambda item: item[0].nbytes)
 
     def _wait(self, device_out) -> np.ndarray:
         """Gather a device result, attributing blocked time to 'device_wait'."""
@@ -185,9 +196,9 @@ class Extractor(abc.ABC):
             pack = self.pack_spec()
             if pack is None:
                 print(f"--pack_corpus ignored: {self.feature_type} has no "
-                      "shape-compatible packing path under this config "
-                      "(flow/audio models and --show_pred use the per-video "
-                      "loop)")
+                      "packing path under this config (--show_pred debug "
+                      "runs and the single-clip frame-sharded flow sandwich "
+                      "use the per-video loop)")
         workers = self.cfg.decode_workers
         if workers > 1 and self.uses_frame_stream:
             self._decode_pool = DecodePrefetcher(self._open_inline, workers)
@@ -516,8 +527,13 @@ class Extractor(abc.ABC):
         extracted = 0
         resumed = 0
         cursor = 0  # decode-window cursor over `todo`
+        if spec.prepare is not None:
+            # corpus-level planning (e.g. the flow extractors' shape-bucket
+            # clustering over container probes) before any decode starts
+            spec.prepare(todo)
         self.clock = StageClock() if with_metrics else None  # corpus-level
-        packer = CorpusPacker(spec, wait=self._wait, clock=self.clock)
+        packer = CorpusPacker(spec, wait=self._wait, clock=self.clock,
+                              flush_age=self.cfg.pack_flush_age)
         pending_writes = self._pending_writes
         pending_writes.clear()
         timeout = self.cfg.video_timeout
@@ -529,12 +545,21 @@ class Extractor(abc.ABC):
             fault_point("extract", path)
             info, clips = spec.open_clips(path)
             packer.begin(path, info)
-            for clip in clips:
-                packer.add(path, clip)
-                if deadline is not None and time.perf_counter() > deadline:
-                    raise VideoTimeoutError(
-                        f"{path}: packed clip stream exceeded --video_timeout "
-                        f"({timeout:.3g}s); failing this video")
+            try:
+                for clip in clips:
+                    packer.add(path, clip)
+                    if deadline is not None and time.perf_counter() > deadline:
+                        raise VideoTimeoutError(
+                            f"{path}: packed clip stream exceeded "
+                            f"--video_timeout ({timeout:.3g}s); failing this "
+                            f"video")
+            finally:
+                # an abandoned generator's cleanup (temp-wav deletion, capture
+                # release) must run before any retry re-opens the same path,
+                # not whenever GC collects the frame
+                close = getattr(clips, "close", None)
+                if close is not None:
+                    close()
             packer.finish(path)
 
         def attempt_with_retries(path: str) -> None:
@@ -604,8 +629,10 @@ class Extractor(abc.ABC):
             flush_error = None
             try:
                 # dispatch partial shape queues (zero-padded tails) and
-                # resolve the final in-flight batch — where tail-batch device
-                # failures actually surface
+                # resolve the final in-flight batches — tail-batch device
+                # failures are contained per bucket inside flush() and
+                # surface as flush_causes on the drained victims; this
+                # except is a safety net for non-dispatch failures
                 packer.flush()
             except KeyboardInterrupt:
                 raise
@@ -613,13 +640,16 @@ class Extractor(abc.ABC):
                 flush_error = e
             emit_completed()
             for asm in packer.drain_incomplete():
-                # rows lost to a failed co-packed batch (mid-run or at
-                # flush): fail each contributing video so it lands in the
-                # failure manifest (DeviceError is transient — a
-                # --retry_failed pass reprocesses exactly these) instead of
-                # crashing the run or silently denting the return value
-                cause = (f": {flush_error}" if flush_error is not None
-                         else "")
+                # rows lost to a failed co-packed batch (mid-run, at a stale
+                # flush, or at the corpus flush): fail each contributing
+                # video so it lands in the failure manifest (DeviceError is
+                # transient — a --retry_failed pass reprocesses exactly
+                # these) instead of crashing the run or silently denting the
+                # return value
+                causes = packer.flush_causes(asm.video)
+                if flush_error is not None:
+                    causes.append(str(flush_error))
+                cause = f": {'; '.join(causes)}" if causes else ""
                 self._fail(asm.video, DeviceError(
                     f"{asm.video}: a co-packed device batch failed before "
                     f"this video's clips resolved{cause}; rerun with "
@@ -630,6 +660,8 @@ class Extractor(abc.ABC):
             "dispatched_slots": packer.dispatched_slots,
             "occupancy": round(packer.occupancy, 4),
             "video_clips": dict(packer.video_clips),
+            "buckets": packer.bucket_stats(),
+            "stale_flushes": packer.stale_flushes,
         }
         if with_metrics:
             dt = time.perf_counter() - t_run
@@ -638,6 +670,15 @@ class Extractor(abc.ABC):
                 # canonical standalone occupancy line (once) after the run
                 print(self.clock.report(
                     f"packed corpus ({extracted} videos)", dt))
+                # ROADMAP item 4: pin the decode-starvation signal — padding
+                # burned while the run sat blocked on decode means the decode
+                # pool, not the mesh, is the ceiling
+                starved = decode_starvation_warning(
+                    occupancy=packer.occupancy,
+                    decode_seconds=self.clock.seconds.get("decode", 0.0),
+                    wall=dt, stale_flushes=packer.stale_flushes)
+                if starved:
+                    print(starved, file=sys.stderr)
             print(f"extracted {extracted}/{len(paths)} videos "
                   f"({resumed} resumed) in {dt:.2f}s")
         self.clock = None

@@ -34,6 +34,7 @@ import numpy as np
 
 from ..models.raft import (
     pad_to_multiple,
+    pad_to_shape,
     raft_forward,
     raft_forward_frames,
     raft_forward_frames_sharded,
@@ -63,6 +64,9 @@ class ExtractFlow(Extractor):
         # --precompile: geometries already warmed (or warming) in background
         self._precompiled: set = set()
         self._precompile_lock = threading.Lock()
+        # --pack_corpus: corpus bucket plan (PackSpec.prepare fills it from
+        # the container probes before the packed loop starts)
+        self._pack_buckets = None
         flow_dtype = jnp.bfloat16 if cfg.flow_dtype == "bfloat16" else jnp.float32
         # D2H transfer dtype: the jitted steps cast their output to this on
         # device; the host upcasts back to fp32. float16 halves the fetched
@@ -207,22 +211,30 @@ class ExtractFlow(Extractor):
         elif self._pads_input:
             frames, pads = pad_to_multiple(frames, 8)
         flow = self._device_call(frames)
-        if self._async_copy_ok:
-            try:
-                flow.copy_to_host_async()
-            except Exception as e:  # noqa: BLE001 — fault-barrier: optional-optimization probe (see below)
-                # backend lacks async host copy (AttributeError /
-                # NotImplementedError / backend-specific UNIMPLEMENTED
-                # runtime errors) — probe once, disarm, and say WHICH error
-                # disarmed it, so a genuine transfer fault is visible here
-                # instead of resurfacing context-free at _wait (the old
-                # blanket `pass` hid it; crashing extraction on an optional
-                # optimization would be worse)
-                self._async_copy_ok = False
-                print(f"[flow] async D2H disabled after "
-                      f"{type(e).__name__}: {e}; transfers will not "
-                      f"overlap compute", flush=True)
+        self._start_async_copy(flow)
         return flow, n_pairs, pads
+
+    def _start_async_copy(self, flow) -> None:
+        """Enqueue the D2H transfer right behind the compute so the fetch
+        rides the DMA engines while the host decodes and the device computes
+        the next batch — dense flow is the framework's only D2H-heavy output,
+        and both the per-video and packed dispatch paths overlap it."""
+        if not self._async_copy_ok:
+            return
+        try:
+            flow.copy_to_host_async()
+        except Exception as e:  # noqa: BLE001 — fault-barrier: optional-optimization probe (see below)
+            # backend lacks async host copy (AttributeError /
+            # NotImplementedError / backend-specific UNIMPLEMENTED
+            # runtime errors) — probe once, disarm, and say WHICH error
+            # disarmed it, so a genuine transfer fault is visible here
+            # instead of resurfacing context-free at _wait (the old
+            # blanket `pass` hid it; crashing extraction on an optional
+            # optimization would be worse)
+            self._async_copy_ok = False
+            print(f"[flow] async D2H disabled after "
+                  f"{type(e).__name__}: {e}; transfers will not "
+                  f"overlap compute", flush=True)
 
     def _collect_pairs(self, handle) -> np.ndarray:
         """Materialize a dispatched window → (n_pairs, 2, H, W) fp32 host flow."""
@@ -267,7 +279,13 @@ class ExtractFlow(Extractor):
         starting its own. One wasted zeros execution per NEW geometry; repeat
         geometries return immediately.
         """
-        h, w = self._padded_geometry(width, height)
+        self._start_precompile_padded(self._padded_geometry(width, height))
+
+    def _start_precompile_padded(self, padded_hw) -> None:
+        """Warm the device program for an already-padded (H, W) geometry —
+        the packed loop warms each video's *bucket* geometry (the program the
+        packed windows actually dispatch) rather than its own padding."""
+        h, w = padded_hw
         with self._precompile_lock:
             if (h, w) in self._precompiled:
                 return
@@ -287,6 +305,122 @@ class ExtractFlow(Extractor):
 
         threading.Thread(target=warm, daemon=True,
                          name=f"flow-precompile:{h}x{w}").start()
+
+    # --- corpus packing (--pack_corpus) ------------------------------------
+
+    def pack_spec(self):
+        """Corpus-packing seam for dense flow: a slot is one frame *pair*.
+
+        ``open_clips`` yields ``(2, Hb, Wb, 3)`` uint8 pairs already padded to
+        the video's bucket geometry (``ShapeBuckets`` over the corpus's
+        container probes — ≤ ``--pack_buckets`` compiled programs for a
+        mixed-resolution corpus). ``collate`` chains stream-consecutive pairs
+        back into one ``(batch_size + 1)``-frame shared-frame window — the
+        same encode-once program :meth:`_device_call` runs in the per-video
+        loop (frame-sharded with halo exchange on multi-device meshes) — so
+        the tail of video N's pairs co-batches with the head of video N+1 at
+        the cost of one burned frame position per video boundary inside a
+        window. Each pair's flow is a pure function of its two frames under
+        a fixed program, so packed outputs are byte-identical to the
+        per-video loop whenever the bucket equals the video's own padded
+        geometry (always true for single-geometry corpora; a merged bucket
+        carries --shape_bucket's documented border-perturbation caveat).
+
+        ``--show_pred`` keeps the per-video loop: its frame+flow
+        visualizations assume video order.
+        """
+        if self.cfg.show_pred:
+            return None
+        from ..parallel.packer import PackSpec, ShapeBuckets
+
+        batch = self.batch_size  # pairs per window
+
+        def prepare(paths):
+            from ..io.video import probe_geometries
+
+            geoms = [self._padded_geometry(w, h)
+                     for w, h in probe_geometries(paths).values()]
+            self._pack_buckets = (
+                ShapeBuckets(geoms, self.cfg.pack_buckets) if geoms else None)
+
+        def open_clips(path):
+            meta, frames = self._open_video(path)
+            geom = self._padded_geometry(meta.width, meta.height)
+            bucket = (self._pack_buckets.bucket_for(geom)
+                      if self._pack_buckets is not None else geom)
+            if self.cfg.precompile:
+                self._start_precompile_padded(bucket)
+            info = {
+                "fps": meta.fps,
+                "timestamps_ms": [],
+                # zero-pair videos reproduce the per-video loop's quirk of
+                # shaping the empty output from the NATIVE container geometry
+                "native_hw": (meta.height, meta.width),
+                "pads": (0, 0, 0, 0),
+            }
+
+            def clips():
+                prev = None
+                for rgb, pos in self._timed_frames(frames):
+                    info["timestamps_ms"].append(pos)
+                    frame, info["pads"] = pad_to_shape(rgb, bucket)
+                    if prev is not None:
+                        yield np.stack([prev, frame])
+                    prev = frame
+
+            return info, clips()
+
+        def collate(clips, stream_keys):
+            # chain consecutive pairs (same stream, idx + 1) into a shared-
+            # frame window of `batch` pairs / `batch + 1` frame positions; a
+            # chain break costs one extra frame position, and the window tail
+            # repeats the last frame exactly like the per-video loop's
+            # partial-batch padding
+            capacity = batch + 1
+            frames, row_of = [], []
+            n_used, last = 0, None
+            for clip, (stream, idx) in zip(clips, stream_keys):
+                chained = last == (stream, idx - 1)
+                if len(frames) + (1 if chained else 2) > capacity:
+                    break
+                if not chained:
+                    frames.append(clip[0])
+                frames.append(clip[1])
+                row_of.append(len(frames) - 2)
+                last = (stream, idx)
+                n_used += 1
+            while len(frames) < capacity:
+                frames.append(frames[-1])
+            return np.stack(frames).astype(np.float32), n_used, row_of
+
+        def step(window):
+            out = self._device_call(np.ascontiguousarray(window))
+            # same overlap as the per-video loop's _dispatch_pairs: the
+            # packer fetches this batch only when the bucket's NEXT batch
+            # dispatches, so the transfer races compute, not the fetch
+            self._start_async_copy(out)
+            return out
+
+        def finalize(path, rows, info):
+            if rows.shape[0] == 0:
+                h, w = info["native_hw"]
+                flow = np.zeros((0, 2, h, w), np.float32)
+            else:
+                if rows.dtype != np.float32:  # transfer_dtype: upcast on host
+                    rows = rows.astype(np.float32)
+                if any(info["pads"]):
+                    rows = unpad(rows, info["pads"])
+                # NHWC rows → reference byte layout (n_pairs, 2, H, W)
+                flow = rows.transpose(0, 3, 1, 2)
+            return {
+                self.feature_type: flow,
+                "fps": np.array(info["fps"]),
+                "timestamps_ms": np.array(info["timestamps_ms"]),
+            }
+
+        return PackSpec(batch_size=batch, empty_row_shape=(0, 0, 2),
+                        open_clips=open_clips, step=step, finalize=finalize,
+                        collate=collate, prepare=prepare)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = self._open_video(video_path)

@@ -296,15 +296,26 @@ class ExtractI3D(Extractor):
         return pil_edge_resize(rgb, self.pre_crop_size)
 
     def pack_spec(self):
-        """Corpus-packing seam for the rgb-only stream: slots are
+        """Corpus-packing seam for every stream mix: slots are
         ``(stack_size + 1, H, W, 3)`` resized stacks, shape-keyed per decoded
-        geometry (the 256-edge resize keys queues by aspect ratio). Flow jobs
-        keep the per-video loop — the flow sandwich's frame-sharded /
-        pair-chunked step geometry is not a fixed-shape packable slot — and
-        two-stream jobs ride with them (both streams consume one batch)."""
-        if self.cfg.show_pred or self.streams != ("rgb",):
+        geometry (the 256-edge resize keys queues by aspect ratio; the
+        bucket-planning flow extractors bound geometry counts — here distinct
+        aspect ratios simply fill distinct queues and the anti-starvation
+        flush keeps rare ones from stranding). Flow and two-stream jobs pack
+        too: a sandwich *stack* is a self-contained slot (each stack's flow
+        is computed inside it by the same jitted ``_flow_step`` the per-video
+        loop runs), and two-stream steps feed one device batch to both
+        streams, stacking the per-stream features along a new axis that
+        ``finalize`` splits back into output keys.
+
+        Fallbacks: ``--show_pred`` (per-batch prints assume video order) and
+        the single-clip frame-sharded flow sandwich (one clip IS the device
+        batch — there is nothing to co-pack)."""
+        if self.cfg.show_pred or self._flow_frame_sharded:
             return None
         from ..parallel.packer import PackSpec
+
+        streams = self.streams
 
         def open_clips(path):
             meta, frames_iter = self._open_video(path)
@@ -323,19 +334,25 @@ class ExtractI3D(Extractor):
             return info, clips()
 
         def step(stacks_u8):
-            feats, _logits = self._rgb_step(self.i3d_params["rgb"],
-                                            self.runner.put(stacks_u8))
-            return feats
+            dev = self.runner.put(stacks_u8)
+            feats = []
+            for s in streams:
+                stream_step = self._rgb_step if s == "rgb" else self._flow_step
+                f, _logits = stream_step(self.i3d_params[s], dev)
+                feats.append(f)
+            # (N, n_streams, 1024): one fetchable array per batch; the
+            # per-stream split happens on host in finalize
+            return jnp.stack(feats, axis=1)
 
         def finalize(path, rows, info):
-            return {
-                "rgb": rows,
-                "fps": np.array(info["fps"]),
-                "timestamps_ms": np.array(info["timestamps_ms"]),
-            }
+            out = {s: np.ascontiguousarray(rows[:, k])
+                   for k, s in enumerate(streams)}
+            out["fps"] = np.array(info["fps"])
+            out["timestamps_ms"] = np.array(info["timestamps_ms"])
+            return out
 
         return PackSpec(batch_size=self.clips_per_batch,
-                        empty_row_shape=(1024,),
+                        empty_row_shape=(len(streams), 1024),
                         open_clips=open_clips, step=step, finalize=finalize)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:

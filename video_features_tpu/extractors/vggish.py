@@ -73,6 +73,53 @@ class ExtractVGGish(Extractor):
 
         return self.runner.jit(step)
 
+    def pack_spec(self):
+        """Corpus-packing seam: every device slot is one fixed ``(96, 64)``
+        log-mel example, so the whole corpus shares a single shape queue —
+        the structurally simplest PackSpec in the repo (audio was excluded
+        from PR 4's RGB-only packing for no structural reason). The VGG
+        forward has no cross-sample ops and packed batches run the SAME
+        jitted program at the same static ``example_batch`` shape, so
+        embeddings are byte-identical to the per-video loop; the PCA
+        postprocessor (when enabled) runs per video in ``finalize``, exactly
+        where the per-video loop applies it."""
+        from ..parallel.packer import PackSpec
+
+        def open_clips(path):
+            wav_path = path
+            aac_path = None
+            extracted = False
+            if not path.endswith(".wav"):
+                wav_path, aac_path = ffmpeg_io.extract_wav_from_mp4(
+                    path, self.tmp_dir)
+                extracted = True
+
+            def clips():
+                try:
+                    for example in wav_to_examples(wav_path):  # (96, 64) each
+                        yield example
+                finally:
+                    # generator close/exhaustion = the per-video loop's
+                    # finally: temp audio never outlives its video's stream
+                    if extracted and not self.cfg.keep_tmp_files:
+                        for p in (wav_path, aac_path):
+                            if p and os.path.exists(p):
+                                os.remove(p)
+
+            return {}, clips()
+
+        def step(examples):
+            return self._step(self.params, self.runner.put(examples))
+
+        def finalize(path, rows, info):
+            if self.postprocessor is not None:
+                rows = self.postprocessor.postprocess(rows)
+            return {self.feature_type: rows}
+
+        return PackSpec(batch_size=self.example_batch,
+                        empty_row_shape=(EMBEDDING_SIZE,),
+                        open_clips=open_clips, step=step, finalize=finalize)
+
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         wav_path = video_path
         aac_path = None

@@ -63,6 +63,38 @@ def probe_video(video_path: str) -> VideoMeta:
         cap.release()
 
 
+def probe_geometries(paths, workers: int = 8) -> "dict[str, Tuple[int, int]]":
+    """``{path: (width, height)}`` for every probeable container in ``paths``.
+
+    Header-only (no frame decode) and probed ``workers``-wide — on a large
+    corpus over network storage a serial sweep would stall the mesh for the
+    sum of every container-open latency before extraction starts. The corpus
+    packer's shape-bucket planner uses the result to choose padded bucket
+    geometries up front. Unprobeable paths are skipped here, not failed: the
+    real open will classify them with full per-video fault attribution
+    (manifest record, retries, circuit breaker). Workers return values only
+    (results are assembled on the calling thread — no cross-thread stores).
+    """
+
+    def probe_one(path):
+        try:
+            meta = probe_video(path)
+        except (DecodeError, OSError):
+            return None
+        return path, (meta.width, meta.height)
+
+    paths = list(paths)
+    if workers > 1 and len(paths) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(workers, len(paths)),
+                                thread_name_prefix="probe") as pool:
+            results = list(pool.map(probe_one, paths))
+    else:
+        results = [probe_one(p) for p in paths]
+    return dict(r for r in results if r is not None)
+
+
 def _raw_frames(cap: cv2.VideoCapture) -> Iterator[Tuple[np.ndarray, float]]:
     """Yield (rgb_uint8_hwc, pos_msec) frames with the first-frame workaround.
 

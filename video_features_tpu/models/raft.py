@@ -636,17 +636,37 @@ def pad_to_multiple(frames: np.ndarray, m: int) -> Tuple[np.ndarray, Tuple[int, 
     Returns (padded, (top, bottom, left, right)) for :func:`unpad`.
     """
     h, w = frames.shape[-3:-1]
-    ph = (m - h % m) % m
-    pw = (m - w % m) % m
-    top, bottom = ph // 2, ph - ph // 2
-    left, right = pw // 2, pw - pw // 2
-    pad = [(0, 0)] * (frames.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
-    return np.pad(frames, pad, mode="edge"), (top, bottom, left, right)
+    # delegate to pad_to_shape: the packed loop's byte-parity contract needs
+    # the /8 pad and the explicit-bucket pad to be the SAME split forever
+    return pad_to_shape(frames, (-(-h // m) * m, -(-w // m) * m))
 
 
 def pad_to_multiple_of_8(frames: np.ndarray) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
     """The reference's /8 input pad (raft.py:27-44)."""
     return pad_to_multiple(frames, 8)
+
+
+def pad_to_shape(frames: np.ndarray, target_hw: Tuple[int, int],
+                 ) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
+    """Replicate-pad (…, H, W, C) to an explicit ``(H, W)`` bucket geometry.
+
+    Same centered sintel split as :func:`pad_to_multiple` — when the target
+    is the geometry's own /8 (or ``--shape_bucket``) padding, the result is
+    byte-identical to the per-video path's pad, which is what the packed
+    flow loop's byte-parity contract rides on. Returns (padded, pads) for
+    :func:`unpad`.
+    """
+    th, tw = target_hw
+    h, w = frames.shape[-3:-1]
+    if th < h or tw < w:
+        raise ValueError(f"cannot pad {h}x{w} frames down to bucket {th}x{tw}")
+    ph, pw = th - h, tw - w
+    if not (ph or pw):
+        return frames, (0, 0, 0, 0)
+    top, bottom = ph // 2, ph - ph // 2
+    left, right = pw // 2, pw - pw // 2
+    pad = [(0, 0)] * (frames.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
+    return np.pad(frames, pad, mode="edge"), (top, bottom, left, right)
 
 
 def unpad(x: np.ndarray, pads: Tuple[int, int, int, int]) -> np.ndarray:

@@ -13,6 +13,24 @@ head of video N+1. Per-clip results scatter back to per-video assembly
 buffers (:class:`..io.output.FeatureAssembly`) that the run loop flushes
 through the output writer as each video's last clip lands.
 
+Three generalizations beyond the original RGB-only packer:
+
+- **collate seam** — a :class:`PackSpec` may supply ``collate`` to build the
+  device batch itself (and decide how many queued slots actually fit). The
+  flow extractors use it to chain stream-consecutive frame-*pair* slots into
+  one ``(batch_size + 1)``-frame shared-frame window: each video boundary
+  inside a window burns one frame position, and the returned row map tells
+  the scatter which output row belongs to which slot.
+- **shape buckets** — :class:`ShapeBuckets` clusters the corpus's probed
+  (padded) geometries into ≤ K buckets before decode starts, so a mixed
+  720p/1080p corpus compiles K programs and co-packs inside each bucket
+  instead of filling one queue per distinct geometry.
+- **per-bucket dispatch** — each shape key keeps its own one-batch-in-flight
+  pipeline (batch *k* is fetched when that bucket's batch *k+1* dispatches),
+  and an anti-starvation flush dispatches a bucket's partial queue once
+  ``flush_age`` videos have finished while it sat waiting — a rare geometry
+  cannot strand its videos until corpus end.
+
 Threading model — deliberately single-threaded: the packed run loop (one
 consumer) pulls each video's clip stream in corpus order and calls
 :meth:`CorpusPacker.add`; decode parallelism comes from the
@@ -30,8 +48,21 @@ die with it.
 
 from __future__ import annotations
 
+import heapq
+import sys
+from collections import Counter
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -45,23 +76,105 @@ class PackSpec:
     ``open_clips(path)`` returns ``(info, clip_iter)``: a mutable per-video
     info dict the stream fills as it decodes (fps, timestamps) and an iterator
     of fixed-shape uint8 clip arrays — one device-batch *slot* each. Clips of
-    equal shape co-pack; a mixed-geometry corpus fills one queue per shape.
+    equal shape co-pack; each distinct shape fills its own queue (the flow
+    extractors bound the shape count with :class:`ShapeBuckets`).
 
     ``step(batch)`` runs the model's existing jitted device step on a full
-    host batch ``(batch_size, *clip_shape)`` and returns the per-slot device
-    features; the packer fetches them through the extractor's device_wait-
-    accounted ``_wait``. ``finalize(path, rows, info)`` assembles the video's
-    output dict from the in-order ``(n_clips, *row)`` host feature array.
+    host batch and returns the per-slot device features; the packer fetches
+    them through the extractor's device_wait-accounted ``_wait``.
+    ``finalize(path, rows, info)`` assembles the video's output dict from the
+    in-order ``(n_clips, *row)`` host feature array.
 
     ``empty_row_shape`` shapes the zero-clip video output (e.g. ``(2048,)``
     for ResNet-50), matching the per-video loop's empty result.
+
+    ``collate(clips, stream_keys)``, when given, replaces the default
+    ``np.stack + pad_batch`` batch assembly: it receives up to ``batch_size``
+    queued clips plus their ``(stream_id, clip_idx)`` continuity keys
+    (consecutive iff same stream and ``idx + 1``) and returns
+    ``(batch, n_used, row_of)`` — the device batch, how many of the offered
+    slots it consumed (≥ 1), and for each consumed slot the row of
+    ``step(batch)``'s output holding its features.
+
+    ``prepare(paths)``, when given, runs once before the packed loop starts —
+    the flow extractors use it to probe the corpus's container geometries and
+    plan the shape buckets.
     """
 
     batch_size: int
     empty_row_shape: Tuple[int, ...]
     open_clips: Callable[[str], Tuple[dict, Iterator[np.ndarray]]]
-    step: Callable[[np.ndarray], Any]
+    step: Callable[[Any], Any]
     finalize: Callable[[str, np.ndarray, dict], Dict[str, np.ndarray]]
+    collate: Optional[
+        Callable[[List[np.ndarray], List[Tuple[int, int]]],
+                 Tuple[Any, int, Sequence[int]]]] = None
+    prepare: Optional[Callable[[Sequence[str]], None]] = None
+
+
+class ShapeBuckets:
+    """Cluster probed (padded) geometries into at most ``max_buckets``.
+
+    Built from the corpus's container probes before decode starts. Each
+    bucket is the elementwise max of its member geometries; merging is
+    greedy — while over the cap, merge the pair whose union adds the least
+    video-weighted padding area. ``bucket_for`` maps a geometry to the
+    smallest covering bucket (a geometry no planned bucket covers — e.g. a
+    video whose probe failed — becomes its own ad-hoc bucket, preserving
+    correctness at the cost of one extra compiled program).
+    """
+
+    def __init__(self, geometries: Iterable[Tuple[int, int]],
+                 max_buckets: int):
+        if max_buckets < 1:
+            raise ValueError("max_buckets must be >= 1")
+        counts = Counter(tuple(g) for g in geometries)
+        # {id: (h, w, weight)} working set; weight = videos whose padding the
+        # bucket's growth would touch. The greedy merge (pop the cheapest
+        # union while over the cap) runs on a lazily-invalidated pair-cost
+        # heap — a dead id (already merged) just skips — so planning a very
+        # heterogeneous corpus costs O(G^2 log G), not O(G^3) rescans of
+        # every pair per round.
+        alive: Dict[int, Tuple[int, int, int]] = {
+            k: (h, w, n) for k, ((h, w), n) in enumerate(counts.items())}
+        next_id = len(alive)
+
+        def pair_cost(a, b):
+            ha, wa, na = alive[a]
+            hb, wb, nb = alive[b]
+            mh, mw = max(ha, hb), max(wa, wb)
+            return (mh * mw * (na + nb) - ha * wa * na - hb * wb * nb,
+                    (mh, mw, na + nb))
+
+        heap = []
+        if len(alive) > max_buckets:
+            ids = list(alive)
+            for x, a in enumerate(ids):
+                for b in ids[x + 1:]:
+                    heap.append((pair_cost(a, b)[0], a, b))
+            heapq.heapify(heap)
+        while len(alive) > max_buckets:
+            cost, a, b = heapq.heappop(heap)
+            if a not in alive or b not in alive:
+                continue  # a stale pair: one side was merged away
+            _, merged = pair_cost(a, b)
+            del alive[a], alive[b]
+            alive[next_id] = merged
+            for other in list(alive):
+                if other != next_id:
+                    heapq.heappush(
+                        heap, (pair_cost(other, next_id)[0], other, next_id))
+            next_id += 1
+        self.buckets: List[Tuple[int, int]] = sorted(
+            (h, w) for h, w, _n in alive.values())
+
+    def bucket_for(self, geometry: Tuple[int, int]) -> Tuple[int, int]:
+        h, w = geometry
+        covering = [(bh * bw, (bh, bw)) for bh, bw in self.buckets
+                    if bh >= h and bw >= w]
+        if not covering:
+            return (h, w)
+        return min(covering)[1]
 
 
 class _Slot:
@@ -78,25 +191,49 @@ class _Slot:
 class CorpusPacker:
     """Shape-keyed continuous batching across videos.
 
-    One dispatched batch is kept in flight: batch *k*'s results are fetched
-    (and scattered) only when batch *k+1* dispatches or at :meth:`flush`, so
-    host decode/stacking of the next batch overlaps device compute of the
-    current one — the packed loop's analogue of the per-video loop's
-    prefetch + ``_throttle`` backpressure (at most one unfetched batch).
+    Each shape key keeps one dispatched batch in flight: that key's batch *k*
+    results are fetched (and scattered) only when its batch *k+1* dispatches,
+    at an anti-starvation flush, or at :meth:`flush` — so host decode/stacking
+    of the next batch overlaps device compute of the current one, the packed
+    loop's analogue of the per-video loop's prefetch + ``_throttle``
+    backpressure (at most one unfetched batch per bucket; the bucket planner
+    bounds the bucket count).
+
+    ``flush_age`` > 0 arms the anti-starvation flush: when a key's queue has
+    sat non-empty while ``flush_age`` videos finished their streams, its
+    partial queue is dispatched zero-padded and resolved eagerly, so a rare
+    bucket's videos complete (and their writes land) mid-run instead of at
+    corpus end.
     """
 
     def __init__(self, spec: PackSpec, wait: Callable[[Any], np.ndarray],
-                 clock=None):
+                 clock=None, flush_age: int = 0):
         self._spec = spec
         self._wait = wait
         self._clock = clock  # optional StageClock: packed_slots/packed_clips units
+        self._flush_age = flush_age
         self._pending: Dict[tuple, List[_Slot]] = {}
         self._open: Dict[str, FeatureAssembly] = {}
         self._finished: List[FeatureAssembly] = []
-        self._inflight: Optional[Tuple[List[_Slot], Any]] = None
+        # per shape key: (slots, row_of, device_out) of the unfetched batch
+        self._inflight: Dict[tuple, Tuple[List[_Slot], Sequence[int], Any]] = {}
+        # per shape key: videos-finished count when its queue last became
+        # non-empty (anti-starvation age base)
+        self._queue_born: Dict[tuple, int] = {}
+        self._videos_finished = 0
         self.real_slots = 0  # clips dispatched
-        self.dispatched_slots = 0  # clips + zero padding dispatched
+        self.dispatched_slots = 0  # clips + padding/boundary slots dispatched
         self.video_clips: Dict[str, int] = {}  # per finished video
+        # per shape key: {"real_slots", "dispatched_slots", "stale_flushes"}
+        self._bucket_stats: Dict[tuple, Dict[str, int]] = {}
+        # device failures contained by the anti-starvation flush barrier,
+        # failed-flush causes (anti-starvation or corpus-end), keyed by shape
+        # bucket — the run loop attributes each drained victim only its own
+        # buckets' causes
+        self.flush_errors: Dict[tuple, List[str]] = {}
+        # per open/finished video: the shape keys its slots were queued
+        # under (cause attribution for stale-flush failures)
+        self._video_keys: Dict[str, set] = {}
 
     # --- per-video lifecycle -------------------------------------------------
 
@@ -109,10 +246,19 @@ class CorpusPacker:
         """Queue one clip; dispatches a device batch when its shape queue fills."""
         asm = self._open[path]
         slot = _Slot(asm, asm.reserve(), clip)
-        queue = self._pending.setdefault(clip.shape, [])
+        key = clip.shape
+        self._video_keys.setdefault(path, set()).add(key)
+        queue = self._pending.setdefault(key, [])
+        # a bucket receiving slots is being fed, not stranded: age counts
+        # from its last activity (slot arrival or dispatch), so a slowly
+        # filling common bucket is never padded-flushed mid-corpus
+        self._queue_born[key] = self._videos_finished
         queue.append(slot)
-        if len(queue) >= self._spec.batch_size:
-            self._dispatch(clip.shape)
+        # a collate may consume fewer than batch_size slots per dispatch
+        # (flow windows burn a frame position per video boundary), so keep
+        # dispatching while the queue stays full
+        while len(queue) >= self._spec.batch_size:
+            self._dispatch(key)
 
     def finish(self, path: str) -> None:
         """Mark ``path``'s stream complete; it finalizes once all rows land."""
@@ -120,16 +266,19 @@ class CorpusPacker:
         asm.finish()
         self.video_clips[path] = asm.expected or 0
         self._finished.append(asm)
+        self._videos_finished += 1
+        self._flush_stale()
 
     def discard(self, path: str) -> None:
         """Drop every trace of ``path``'s current attempt (failure/retry).
 
         Pending slots are unlinked; slots already dispatched (including the
-        in-flight batch) still hold the dead attempt's assembly and scatter
+        in-flight batches) still hold the dead attempt's assembly and scatter
         harmlessly into it — slot-level attribution needs no batch rollback.
         """
         asm = self._open.pop(path, None)
         self.video_clips.pop(path, None)
+        self._video_keys.pop(path, None)
         self._finished = [a for a in self._finished if a.video != path]
         if asm is None:
             return
@@ -138,38 +287,99 @@ class CorpusPacker:
 
     # --- dispatch ------------------------------------------------------------
 
-    def _dispatch(self, shape: tuple) -> None:
+    def _dispatch(self, key: tuple) -> None:
         from ..extractors.base import pad_batch  # runtime: avoids an import cycle
 
-        queue = self._pending[shape]
+        queue = self._pending[key]
         batch_size = self._spec.batch_size
-        slots = queue[:batch_size]
-        del queue[:batch_size]  # in place: flush() iterates this same list
-        batch = pad_batch(np.stack([s.clip for s in slots]), batch_size)
-        self._scatter_inflight()  # resolve batch k before dispatching k+1
+        candidates = queue[:batch_size]
+        if self._spec.collate is not None:
+            batch, n_used, row_of = self._spec.collate(
+                [s.clip for s in candidates],
+                [(id(s.assembly), s.idx) for s in candidates])
+            slots = candidates[:n_used]
+            del queue[:n_used]  # in place: flush() iterates this same list
+        else:
+            slots = candidates
+            del queue[:batch_size]
+            batch = pad_batch(np.stack([s.clip for s in slots]), batch_size)
+            row_of = range(len(slots))
+        self._scatter_inflight(key)  # resolve this bucket's batch k first
         out = self._spec.step(batch)
-        self._inflight = (slots, out)
+        self._inflight[key] = (slots, row_of, out)
+        # a bucket being served is not starving: age counts from its last
+        # activity (dispatch here, slot arrival in add())
+        self._queue_born[key] = self._videos_finished
         self.real_slots += len(slots)
         self.dispatched_slots += batch_size
+        stats = self._bucket_stats.setdefault(
+            key, {"real_slots": 0, "dispatched_slots": 0, "stale_flushes": 0})
+        stats["real_slots"] += len(slots)
+        stats["dispatched_slots"] += batch_size
         if self._clock is not None:
             self._clock.add_units("packed_slots", batch_size)
             self._clock.add_units("packed_clips", len(slots))
 
-    def _scatter_inflight(self) -> None:
-        if self._inflight is None:
+    def _scatter_inflight(self, key: Optional[tuple] = None) -> None:
+        keys = [key] if key is not None else list(self._inflight)
+        for k in keys:
+            inflight = self._inflight.pop(k, None)
+            if inflight is None:
+                continue
+            slots, row_of, out = inflight
+            host = self._wait(out)
+            for i, slot in enumerate(slots):
+                slot.assembly.put(slot.idx, host[row_of[i]])
+
+    def _flush_stale(self) -> None:
+        """Anti-starvation: dispatch (and resolve) buckets whose partial
+        queues sat idle (no slot arrival, no dispatch) for ``flush_age``
+        video completions — latency over overlap for geometries too rare to
+        fill their own batches."""
+        if not self._flush_age:
             return
-        slots, out = self._inflight
-        self._inflight = None
-        host = self._wait(out)
-        for i, slot in enumerate(slots):
-            slot.assembly.put(slot.idx, host[i])
+        for key, queue in list(self._pending.items()):
+            if not queue:
+                continue
+            if self._videos_finished - self._queue_born[key] < self._flush_age:
+                continue
+            try:
+                while queue:
+                    self._dispatch(key)
+                self._scatter_inflight(key)  # rare bucket: complete now
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the stale-flush arm of the per-video isolation point — the flushed batch may hold ZERO slots of the video whose finish() triggered it, so letting this escape would retry/fail the wrong (healthy) video; victims resolve via drain_incomplete with this cause
+                msg = (f"anti-starvation flush of bucket "
+                       f"{'x'.join(str(d) for d in key)} failed: {e}")
+                self.flush_errors.setdefault(key, []).append(msg)
+                print(f"[pack] {msg}; its videos will be failed (retryable) "
+                      "when the corpus drains", file=sys.stderr)
+                continue
+            self._bucket_stats[key]["stale_flushes"] += 1
 
     def flush(self) -> None:
-        """Dispatch every partial shape queue (zero-padded) and resolve in-flight."""
-        for shape, queue in list(self._pending.items()):
-            while queue:
-                self._dispatch(shape)
-        self._scatter_inflight()
+        """Dispatch every partial shape queue (padded) and resolve in-flight.
+
+        Per-bucket fault isolation: one bucket's device failure must not
+        abort the other buckets' dispatch/scatter — healthy buckets still
+        resolve, and the failed bucket's contributors drain incomplete
+        wearing only their own bucket's recorded cause.
+        """
+        keys = set(self._pending) | set(self._inflight)
+        for key in sorted(keys, key=str):
+            try:
+                while self._pending.get(key):
+                    self._dispatch(key)
+                self._scatter_inflight(key)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point — a tail batch holds rows of whichever videos' slots it packed, so letting one bucket's failure escape would fail every other bucket's (healthy) pending videos with the wrong cause; victims resolve via drain_incomplete with this cause
+                msg = (f"corpus flush of bucket "
+                       f"{'x'.join(str(d) for d in key)} failed: {e}")
+                self.flush_errors.setdefault(key, []).append(msg)
+                print(f"[pack] {msg}; its videos will be failed (retryable)",
+                      file=sys.stderr)
 
     # --- results -------------------------------------------------------------
 
@@ -188,9 +398,37 @@ class CorpusPacker:
         self._finished = [a for a in self._finished if a.complete]
         return out
 
+    def flush_causes(self, path: str) -> List[str]:
+        """Flush-failure messages (anti-starvation or corpus-end) for the
+        buckets ``path``'s slots were queued under — a drained victim is
+        blamed only with its own buckets' causes, never a co-resident
+        healthy bucket's."""
+        keys = self._video_keys.get(path, ())
+        return [msg for key in sorted(keys, key=str)
+                for msg in self.flush_errors.get(key, [])]
+
     @property
     def occupancy(self) -> float:
         """Real clips / dispatched device slots (1.0 = no padding dispatched)."""
         if not self.dispatched_slots:
             return 0.0
         return self.real_slots / self.dispatched_slots
+
+    @property
+    def stale_flushes(self) -> int:
+        return sum(s["stale_flushes"] for s in self._bucket_stats.values())
+
+    def bucket_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-shape-key occupancy accounting (JSON-friendly keys)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for key, s in sorted(self._bucket_stats.items(), key=str):
+            name = "x".join(str(d) for d in key)
+            out[name] = {
+                "real_slots": s["real_slots"],
+                "dispatched_slots": s["dispatched_slots"],
+                "occupancy": round(
+                    s["real_slots"] / s["dispatched_slots"], 4)
+                if s["dispatched_slots"] else 0.0,
+                "stale_flushes": s["stale_flushes"],
+            }
+        return out

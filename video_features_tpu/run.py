@@ -109,6 +109,13 @@ def main(argv=None) -> int:
         print(f"packing occupancy: {stats['real_slots']}/"
               f"{stats['dispatched_slots']} device slots "
               f"({stats['occupancy']:.1%})")
+        buckets = stats.get("buckets") or {}
+        if len(buckets) > 1:  # mixed-geometry corpus: per-bucket accounting
+            for name, b in buckets.items():
+                print(f"  bucket {name}: {b['real_slots']}/"
+                      f"{b['dispatched_slots']} slots "
+                      f"({b['occupancy']:.1%}, "
+                      f"stale_flushes={b['stale_flushes']})")
     failed = len(paths) - ok
     if failed:
         print(f"{failed} video(s) failed; classified records in "

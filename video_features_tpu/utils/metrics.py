@@ -21,11 +21,44 @@ import collections
 import contextlib
 import os
 import time
-from typing import Dict, Iterable, Iterator
+from typing import Callable, Dict, Iterable, Iterator, Optional
 
 
 def metrics_enabled(profile_dir=None) -> bool:
     return bool(profile_dir) or os.environ.get("VFT_METRICS") == "1"
+
+
+# decode-starvation heuristic (--pack_corpus): warn when the packer burned a
+# lot of padding (occupancy below the threshold) while the run spent most of
+# its wall blocked pulling frames — the decode pool, not the mesh, was the
+# ceiling (ROADMAP item 4). Thresholds are deliberately loose: this is a
+# "look at --decode_workers" nudge, not an SLO.
+STARVED_OCCUPANCY = 0.8
+STARVED_DECODE_FRACTION = 0.4
+
+
+def decode_starvation_warning(occupancy: float, decode_seconds: float,
+                              wall: float, stale_flushes: int = 0,
+                              ) -> Optional[str]:
+    """Message when a packed run's padding is decode-starvation, else None.
+
+    ``occupancy``: real clips / dispatched device slots for the whole corpus.
+    ``decode_seconds``: host time blocked on the frame stream ('decode' stage).
+    ``wall``: packed-run wall-clock. ``stale_flushes``: anti-starvation
+    flushes taken (each one trades padding for latency, so a high count with
+    low occupancy strengthens the signal — it is reported, not gated on).
+    """
+    if wall <= 0 or occupancy >= STARVED_OCCUPANCY:
+        return None
+    decode_fraction = decode_seconds / wall
+    if decode_fraction < STARVED_DECODE_FRACTION:
+        return None
+    return (f"warning: packing occupancy {occupancy:.1%} with "
+            f"{decode_fraction:.0%} of wall blocked on decode"
+            + (f" and {stale_flushes} anti-starvation flush(es)"
+               if stale_flushes else "")
+            + " — the decode pool is starving the mesh; raise "
+            "--decode_workers (docs/performance.md)")
 
 
 class StageClock:
@@ -37,6 +70,10 @@ class StageClock:
         # dimensionless counters (no time attached), e.g. the packed loop's
         # dispatched device slots vs real clips (packing occupancy)
         self.units: Dict[str, int] = collections.defaultdict(int)
+        # payload bytes attributed per stage (timed_iter bytes_of): the report
+        # derives stage throughput (MB/s) from bytes/seconds — decode MB/s is
+        # the ingest-rate signal the starvation heuristic keys on
+        self.bytes: Dict[str, int] = collections.defaultdict(int)
 
     def add_units(self, name: str, n: int = 1) -> None:
         """Accumulate a dimensionless counter reported alongside the stages."""
@@ -51,8 +88,13 @@ class StageClock:
             self.seconds[name] += time.perf_counter() - t0
             self.counts[name] += 1
 
-    def timed_iter(self, it: Iterable, name: str) -> Iterator:
-        """Wrap an iterator, attributing time blocked in ``next()`` to ``name``."""
+    def timed_iter(self, it: Iterable, name: str,
+                   bytes_of: Optional[Callable] = None) -> Iterator:
+        """Wrap an iterator, attributing time blocked in ``next()`` to ``name``.
+
+        ``bytes_of(item)``, when given, accounts each item's payload size so
+        the report can state the stage's throughput (e.g. decoded MB/s).
+        """
         it = iter(it)
         while True:
             t0 = time.perf_counter()
@@ -63,12 +105,18 @@ class StageClock:
                 return
             self.seconds[name] += time.perf_counter() - t0
             self.counts[name] += 1
+            if bytes_of is not None:
+                self.bytes[name] += bytes_of(item)
             yield item
 
     def report(self, label: str, wall: float) -> str:
         parts = [f"{label}: wall {wall:.2f}s"]
         for name in sorted(self.seconds):
-            parts.append(f"{name} {self.seconds[name]:.2f}s/{self.counts[name]}")
+            stage = f"{name} {self.seconds[name]:.2f}s/{self.counts[name]}"
+            if self.bytes.get(name) and self.seconds[name] > 0:
+                mbps = self.bytes[name] / self.seconds[name] / 1e6
+                stage += f" ({mbps:.1f} MB/s)"
+            parts.append(stage)
         accounted = sum(self.seconds.values())
         parts.append(f"overlapped/other {max(wall - accounted, 0.0):.2f}s")
         for name in sorted(self.units):
