@@ -921,6 +921,104 @@ def main() -> None:
             bench_packed("packed_vggish", ex, corpus, "example slots",
                          ex.example_batch, warm=warm_vggish)
 
+    # ---- always-on service (--serve) steady state -----------------------------
+    # A stream of staggered small requests through the daemon's warm slot
+    # queues vs the SAME corpus as one batch --pack_corpus run: the serving
+    # loop's scheduling/idle-flush overhead shows up as occupancy lost to
+    # pad-flushes between bursts, and videos_per_sec quantifies the cost of
+    # request-at-a-time arrival. Stale-record protocol unchanged: the entry
+    # rides guarded()/clear_failure like every packed scenario.
+    if not over_budget("service_steady_state"):
+        with guarded("service_steady_state"):
+            import threading as _threading
+
+            from video_features_tpu.serve import ExtractionService
+
+            n_videos = 6 if on_cpu else 24
+            per_request = 2
+            corpus = write_corpus(
+                "service_corpus",
+                [((64, 48), 3 + (i % 4) if on_cpu else 6 + (i % 10))
+                 for i in range(n_videos)])
+            batch = 4 if on_cpu else 64
+
+            def service_cfg(sub):
+                # not the shared cfg() helper: the daemon and the baseline
+                # need DISTINCT output trees (the shared one would dedupe
+                # the second run via its done-manifest)
+                return ExtractionConfig(
+                    feature_type="resnet50", batch_size=batch,
+                    pack_corpus=True, on_extraction="save_numpy",
+                    output_path=os.path.join("/tmp/vft_bench", sub),
+                    tmp_path=os.path.join("/tmp/vft_bench", "tmp"))
+
+            ex_b = ExtractResNet50(service_cfg("svc_batch"))
+
+            def warm_svc(ex=ex_b):
+                _force(ex._step(ex.params, ex.runner.put(
+                    rng.integers(0, 256, (batch, 224, 224, 3),
+                                 dtype=np.uint8))))
+
+            baseline = bench_packed("service_batch_baseline", ex_b, corpus,
+                                    "frame slots", batch, warm=warm_svc)
+
+            shutil.rmtree(os.path.join("/tmp/vft_bench", "svc_serve"),
+                          ignore_errors=True)  # fresh manifests per sweep
+            ex_s = ExtractResNet50(service_cfg("svc_serve"))
+            svc = ExtractionService(ex_s, poll_interval=0.005)
+            requests = [corpus[i:i + per_request]
+                        for i in range(0, len(corpus), per_request)]
+            stagger = 0.15 if on_cpu else 0.05
+
+            feed_err = []
+
+            def feed():
+                try:
+                    for i, vids in enumerate(requests):
+                        svc.submit({"tenant": f"t{i % 2}", "videos": vids,
+                                    "request_id": f"bench-{i}"})
+                        time.sleep(stagger)
+                except Exception as e:  # noqa: BLE001 — re-raised on the bench thread after join
+                    feed_err.append(e)
+                finally:
+                    # a submit failure must still drain, or run() blocks the
+                    # bench forever; guarded() records the re-raised error
+                    svc.request_drain()
+
+            _log(f"service_steady_state: {len(requests)} staggered requests "
+                 f"× {per_request} videos, batch {batch}")
+            feeder = _threading.Thread(target=feed, daemon=True)
+            t0 = time.perf_counter()
+            feeder.start()
+            rc = svc.run()
+            wall = time.perf_counter() - t0
+            feeder.join()
+            if feed_err:
+                raise feed_err[0]
+            if rc != 0:
+                raise RuntimeError(f"service run exited {rc}")
+            packer = svc.packer
+            entry = {
+                "videos_per_sec": round(n_videos / wall, 3),
+                "videos": n_videos,
+                "requests": len(requests),
+                "stagger_sec": stagger,
+                "wall_sec": round(wall, 3),
+                "unit": "frame slots",
+                "packing_occupancy": round(packer.occupancy, 4),
+                "real_slots": packer.real_slots,
+                "dispatched_slots": packer.dispatched_slots,
+                "batch_occupancy_baseline": baseline["packing_occupancy"],
+                "batch_videos_per_sec": baseline["videos_per_sec"],
+                "code_rev": code_rev,
+            }
+            details["service_steady_state"] = entry
+            clear_failure("service_steady_state")
+            flush_details()
+            _log(f"service_steady_state: {entry['videos_per_sec']} videos/s, "
+                 f"occupancy {entry['packing_occupancy']} (one-batch-run "
+                 f"baseline {entry['batch_occupancy_baseline']})")
+
     # ---- end-to-end extract(): decode → transform → device → collect ----------
     # The reference's real workload is whole videos through the full pipeline
     # (SURVEY §3.1 hot loop); device-step benches above exclude decode. Stage

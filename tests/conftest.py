@@ -76,6 +76,7 @@ _FAST_MODULES = {
     "test_reliability",
     "test_resample",
     "test_resnet_extractor",
+    "test_service",
     "test_spatial",
     "test_vftlint",
     "test_video_decode",

@@ -1,0 +1,563 @@
+"""Always-on extraction service (--serve): enqueue→output parity with the
+batch CLI, tenant fairness under contention, poisoned-tenant breaker
+isolation, drain/reload lifecycle, ingest transports (spool + socket), the
+decode autoscaler, and the long-run memory bound — through the same
+lightweight jitted extractor as tests/test_packer.py (shared program shape,
+one trivial CPU compile)."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_packer import ToyPacked, _write_video
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.io.output import load_done_set
+from video_features_tpu.reliability import (
+    DeviceError,
+    TenantBreaker,
+    load_failures,
+    reset_faults,
+)
+from video_features_tpu.serve import (
+    DecodeAutoscaler,
+    ExtractionService,
+    RequestQueue,
+    RequestRejected,
+    SocketAPI,
+    SpoolWatcher,
+    parse_request,
+    socket_request,
+)
+from video_features_tpu.serve.request import ServiceRequest
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """Four decodable tiny videos of mixed lengths (3, 5, 9, 2 frames)."""
+    d = tmp_path_factory.mktemp("serve_corpus")
+    return [_write_video(d / f"vid{i}.mp4", n)
+            for i, n in enumerate((3, 5, 9, 2))]
+
+
+def _cfg(tmp_path, sub, **kw):
+    kw.setdefault("retries", 1)
+    kw.setdefault("retry_backoff", 0.01)
+    if kw.get("serve"):
+        kw.setdefault("spool_dir", str(tmp_path / sub / "spool"))
+        kw.setdefault("idle_flush_sec", 0.0)
+        os.makedirs(kw["spool_dir"], exist_ok=True)
+    return ExtractionConfig(
+        feature_type="resnet50", on_extraction="save_numpy", num_devices=1,
+        output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"), **kw)
+
+
+def _service(tmp_path, sub, **kw):
+    ex = ToyPacked(_cfg(tmp_path, sub, serve=True, **kw))
+    svc = ExtractionService(ex, poll_interval=0.001)
+    return svc
+
+
+def _outputs(tmp_path, sub):
+    return {os.path.basename(p): np.load(p)
+            for p in glob.glob(str(tmp_path / sub / "resnet50" / "*.npy"))}
+
+
+def _assert_bytes_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k].tobytes() == b[k].tobytes(), k
+
+
+def _result(svc, request_id):
+    path = os.path.join(svc.notify_dir, f"{request_id}.result.json")
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---- acceptance: two-tenant daemon run == per-tenant batch runs ------------
+
+
+def test_two_tenant_daemon_matches_per_tenant_batch_runs(tmp_path, corpus):
+    ex_a = ToyPacked(_cfg(tmp_path, "batch_a"))
+    assert ex_a.run(corpus[:2]) == 2
+    ex_b = ToyPacked(_cfg(tmp_path, "batch_b"))
+    assert ex_b.run(corpus[2:]) == 2
+
+    svc = _service(tmp_path, "serve")
+    ra = svc.submit({"tenant": "alice", "videos": corpus[:2]})
+    rb = svc.submit({"tenant": "bob", "videos": corpus[2:]})
+    svc.request_drain()
+    assert svc.run() == 0
+    assert ra.state == "done" and rb.state == "done"
+    _assert_bytes_equal(
+        _outputs(tmp_path, "serve"),
+        {**_outputs(tmp_path, "batch_a"), **_outputs(tmp_path, "batch_b")})
+    assert len(load_done_set(svc.ex.output_dir)) == len(corpus)
+    for r in (ra, rb):
+        record = _result(svc, r.request_id)
+        assert record["state"] == "done"
+        assert len(record["done"]) == 2 and record["failed"] == []
+
+
+def test_idle_flush_completes_requests_without_drain(tmp_path, corpus):
+    """With the queue idle and partial slot queues pending, the daemon
+    pad-flushes after idle_flush_sec so the request completes NOW — requests
+    must not wait for a future burst to fill their tail batch."""
+    svc = _service(tmp_path, "idle")
+    r = svc.submit({"tenant": "a", "videos": [corpus[0]]})  # 3 frames < batch 4
+    for _ in range(50):
+        svc.step()
+        if r.complete:
+            break
+    assert r.state == "done"
+    # queues stay live after the flush: a second request still packs
+    r2 = svc.submit({"tenant": "a", "videos": [corpus[3]]})
+    svc.request_drain()
+    assert svc.run() == 0
+    assert r2.state == "done"
+
+
+# ---- poisoned-tenant isolation (acceptance) --------------------------------
+
+
+def test_poisoned_tenant_trips_only_its_breaker(tmp_path, corpus, monkeypatch):
+    """vid1 (alice) is poisoned: alice's breaker opens, her queued videos
+    fail fast without decoding, her new submissions are rejected — while
+    bob's request completes byte-identical to a clean batch run."""
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:vid1")
+    svc = _service(tmp_path, "poison", tenant_max_failures=0)
+    ra = svc.submit({"tenant": "alice", "videos": [corpus[1], corpus[0]]})
+    rb = svc.submit({"tenant": "bob", "videos": corpus[2:]})
+    svc.request_drain()
+    assert svc.run() == 1  # alice's failures make the exit code honest
+    assert rb.state == "done"
+    assert ra.state == "failed"
+    classes = {f["video"]: f["error_class"] for f in ra.failed}
+    assert classes[os.path.abspath(corpus[1])] == "InjectedDeviceError"
+    assert classes[os.path.abspath(corpus[0])] == "TenantBreakerOpen"
+    assert svc.breaker.tripped("alice") and not svc.breaker.tripped("bob")
+    # bob's outputs are byte-identical to his own batch run
+    ex_b = ToyPacked(_cfg(tmp_path, "poison_batch"))
+    assert ex_b.run(corpus[2:]) == 2
+    got = {k: v for k, v in _outputs(tmp_path, "poison").items()
+           if k.startswith(("vid2", "vid3"))}
+    _assert_bytes_equal(got, _outputs(tmp_path, "poison_batch"))
+    # every failure is manifested for --retry_failed-style reprocessing
+    assert set(load_failures(svc.ex.output_dir)) == {
+        os.path.abspath(corpus[1]), os.path.abspath(corpus[0])}
+
+
+def test_open_breaker_rejects_submissions_until_reload(tmp_path, corpus,
+                                                       monkeypatch):
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:vid1")
+    svc = _service(tmp_path, "breaker", tenant_max_failures=0)
+    svc.submit({"tenant": "alice", "videos": [corpus[1]]})
+    while svc.step():
+        pass
+    assert svc.breaker.tripped("alice")
+    with pytest.raises(RequestRejected, match="breaker is open"):
+        svc.submit({"tenant": "alice", "videos": [corpus[0]]})
+    svc.reload()  # SIGHUP: operator fixed the inputs, let alice back in
+    assert not svc.breaker.tripped("alice")
+    monkeypatch.delenv("VFT_FAULTS")
+    reset_faults()
+    r = svc.submit({"tenant": "alice", "videos": [corpus[0]]})
+    svc.request_drain()
+    assert svc.run() == 1  # vid1's terminal failure still counts
+    assert r.state == "done"
+    svc.close()
+
+
+def test_transient_failure_requeues_through_the_scheduler(tmp_path, corpus,
+                                                          monkeypatch,
+                                                          capsys):
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_transient:vid2:1")
+    svc = _service(tmp_path, "transient", retries=2)
+    r = svc.submit({"tenant": "a", "videos": corpus})
+    svc.request_drain()
+    assert svc.run() == 0
+    assert r.state == "done"
+    assert "re-enqueued" in capsys.readouterr().out
+    assert load_failures(svc.ex.output_dir) == {}
+
+
+def test_copacked_batch_failure_victims_requeue_not_breaker(tmp_path, corpus):
+    """A transient device fault on ONE dispatched batch loses every
+    co-resident video's rows; the daemon re-enqueues the victims through the
+    scheduler (same retry budget) instead of failing them terminally — and
+    an innocent tenant's breaker must not count a neighbour's batch fault."""
+    calls = []
+
+    class BatchPoison(ToyPacked):
+        def pack_spec(self):
+            spec = super().pack_spec()
+            inner = spec.step
+
+            def step(batch):
+                calls.append(1)
+                if len(calls) == 2:  # second dispatched batch, exactly once
+                    raise DeviceError("injected transient device fault")
+                return inner(batch)
+
+            spec.step = step
+            return spec
+
+    cfg = _cfg(tmp_path, "victims", serve=True, retries=2,
+               tenant_max_failures=0)
+    svc = ExtractionService(BatchPoison(cfg), poll_interval=0.001)
+    ra = svc.submit({"tenant": "alice", "videos": corpus[:2]})
+    rb = svc.submit({"tenant": "bob", "videos": corpus[2:]})
+    svc.request_drain()
+    assert svc.run() == 0  # every victim recovered: no terminal failures
+    assert ra.state == "done" and rb.state == "done"
+    assert not svc.breaker.open_tenants()
+    assert load_failures(svc.ex.output_dir) == {}
+    ex_c = ToyPacked(_cfg(tmp_path, "victims_clean"))
+    assert ex_c.run(corpus) == len(corpus)
+    _assert_bytes_equal(_outputs(tmp_path, "victims"),
+                        _outputs(tmp_path, "victims_clean"))
+
+
+# ---- scheduler: quotas, fairness, deadlines --------------------------------
+
+
+def _req(tenant, videos, deadline=None):
+    return ServiceRequest(f"r-{tenant}-{len(videos)}", tenant,
+                          tuple(videos), deadline=deadline)
+
+
+def test_weighted_fair_interleave_under_contention():
+    q = RequestQueue(tenants={"tenants": {"alice": {"weight": 2.0}}})
+    q.submit(_req("alice", [f"/a{i}" for i in range(6)]))
+    q.submit(_req("bob", [f"/b{i}" for i in range(6)]))
+    order = [q.next_job().request.tenant for _ in range(9)]
+    # stride scheduling: alice (weight 2) gets two pops per bob's one
+    assert order.count("alice") == 6 and order.count("bob") == 3
+
+
+def test_uncontended_tenant_runs_at_full_speed_and_idle_banks_no_credit():
+    q = RequestQueue()
+    q.submit(_req("alice", ["/a0", "/a1", "/a2"]))
+    assert [q.next_job().path for _ in range(3)] == ["/a0", "/a1", "/a2"]
+    # alice ran alone for a while; bob waking now must not be starved by
+    # her accumulated vtime, nor alice by bob's zero clock
+    q.submit(_req("alice", ["/a3", "/a4"]))
+    q.submit(_req("bob", ["/b0", "/b1"]))
+    order = [q.next_job().request.tenant for _ in range(4)]
+    assert sorted(order[:2]) == ["alice", "bob"]  # strict alternation
+
+
+def test_deadline_wins_across_tenants():
+    q = RequestQueue()
+    q.submit(_req("slow", ["/s0", "/s1"]))
+    q.submit(_req("urgent", ["/u0"], deadline=time.time() + 5))
+    assert q.next_job().path == "/u0"
+
+
+def test_quota_rejects_all_or_nothing():
+    q = RequestQueue(default_quota=3)
+    q.submit(_req("a", ["/1", "/2"]))
+    with pytest.raises(RequestRejected, match="over quota"):
+        q.submit(_req("a", ["/3", "/4"]))
+    assert q.pending("a") == 2  # nothing from the rejected request queued
+    q.submit(_req("a", ["/3"]))
+    assert q.pending("a") == 3
+
+
+def test_duplicate_inflight_path_rejected():
+    q = RequestQueue()
+    q.submit(_req("a", ["/x"]))
+    with pytest.raises(RequestRejected, match="already queued"):
+        q.submit(_req("b", ["/x"]))
+
+
+def test_requeue_keeps_admission_order_and_drain_tenant_empties():
+    q = RequestQueue()
+    q.submit(_req("a", ["/1", "/2"]))
+    job = q.next_job()
+    q.submit(_req("a", ["/3"]))
+    q.requeue(job)  # retry schedules ahead of the later submission
+    assert [q.next_job().path for _ in range(3)] == ["/1", "/2", "/3"]
+    q.submit(_req("a", ["/4", "/5"]))
+    assert [j.path for j in q.drain_tenant("a")] == ["/4", "/5"]
+    assert q.pending() == 0
+
+
+def test_reload_configure_applies_new_weights_and_quotas():
+    q = RequestQueue(default_quota=2)
+    q.submit(_req("a", ["/1", "/2"]))
+    q.configure({"default": {"quota": 8},
+                 "tenants": {"a": {"weight": 3, "quota": 4}}})
+    q.submit(_req("a", ["/3", "/4"]))  # over the old quota, under the new
+    with pytest.raises(RequestRejected, match="over quota"):
+        q.submit(_req("a", ["/5"]))
+    with pytest.raises(ValueError, match="weight must be > 0"):
+        q.configure({"tenants": {"a": {"weight": 0}}})
+
+
+def test_bad_reload_config_leaves_previous_config_fully_intact():
+    """A failed configure (zero weight, non-numeric quota, quota < 1) must
+    not half-apply: the next pop and the next admission still run on the
+    previous config."""
+    q = RequestQueue(default_quota=2)
+    q.submit(_req("a", ["/1", "/2"]))
+    for bad in ({"default": {"weight": 2, "quota": None}},
+                {"default": {"weight": 2, "quota": "lots"}},
+                {"tenants": {"a": {"weight": 0}}},
+                {"tenants": {"a": {"quota": 0}}},
+                "not an object"):
+        with pytest.raises(ValueError):
+            q.configure(bad)
+    with pytest.raises(RequestRejected, match="over quota"):
+        q.submit(_req("a", ["/3"]))  # still the old quota of 2
+    assert q.next_job().path == "/1"  # weighted pop still works (weight 1)
+
+
+# ---- request parsing -------------------------------------------------------
+
+
+def test_parse_request_validation():
+    r = parse_request({"tenant": "t", "videos": ["/a"], "deadline_sec": 10})
+    assert r.tenant == "t" and r.deadline > time.time()
+    for bad in (["not an object"], {"videos": []}, {"videos": ["/a", "/a"]},
+                {"videos": ["/a"], "deadline_sec": -1},
+                {"videos": [1, 2]}, {"tenant": "", "videos": ["/a"]}):
+        with pytest.raises(RequestRejected):
+            parse_request(bad)
+    assert parse_request({"videos": ["/a"]}).tenant == "default"
+
+
+# ---- tenant breaker (unit) -------------------------------------------------
+
+
+def test_tenant_breaker_threshold_and_reset():
+    b = TenantBreaker(max_failures=1)
+    assert not b.record_failure("a")  # 1 failure: at the threshold, closed
+    assert b.record_failure("a")  # 2nd: trips, True exactly once
+    assert not b.record_failure("a")
+    assert b.tripped("a") and not b.tripped("b")
+    assert list(b.open_tenants()) == ["a"]
+    b.reset("a")
+    assert not b.tripped("a") and b.failures("a") == 0
+    assert TenantBreaker(None).record_failure("x") is False  # never trips
+
+
+# ---- ingest: spool directory + socket API ----------------------------------
+
+
+def test_spool_ingest_accepts_rejects_and_skips_tenants_json(tmp_path,
+                                                             corpus):
+    svc = _service(tmp_path, "spool")
+    spool = svc.cfg.spool_dir
+    with open(os.path.join(spool, "tenants.json"), "w") as f:
+        json.dump({"default": {"weight": 1}}, f)
+    with open(os.path.join(spool, "good.json"), "w") as f:
+        json.dump({"tenant": "alice", "videos": corpus[:2]}, f)
+    with open(os.path.join(spool, "bad.json"), "w") as f:
+        f.write("{not json")
+    with open(os.path.join(spool, "empty.json"), "w") as f:
+        json.dump({"tenant": "alice", "videos": []}, f)
+    watcher = SpoolWatcher(spool, svc)
+    assert watcher.scan_once() == 3  # tenants.json untouched
+    names = sorted(os.listdir(spool))
+    assert names == ["bad.json.rejected", "empty.json.rejected",
+                     "good.json.accepted", "results", "tenants.json"]
+    assert _result(svc, "bad")["state"] == "rejected"
+    assert _result(svc, "empty")["state"] == "rejected"
+    svc.request_drain()
+    assert svc.run() == 0
+    assert _result(svc, "good")["state"] == "done"
+    assert len(_outputs(tmp_path, "spool")) == 4  # 2 videos × (feat, ts)
+
+
+def test_socket_api_round_trip(tmp_path, corpus):
+    svc = _service(tmp_path, "sock")
+    sock = os.path.join(svc.cfg.spool_dir, "control.sock")
+    api = SocketAPI(sock, svc)
+    api.start()
+    try:
+        assert socket_request(sock, {"op": "ping"}) == {"ok": True}
+        resp = socket_request(sock, {"op": "submit", "tenant": "alice",
+                                     "videos": corpus[:1],
+                                     "request_id": "batch-7"})
+        assert resp["ok"] and resp["request_id"] == "batch-7"
+        status = socket_request(sock, {"op": "status",
+                                       "request_id": "batch-7"})
+        assert status["ok"] and status["state"] == "pending"
+        stats = socket_request(sock, {"op": "stats"})
+        assert stats["queued_videos"] == 1 and "alice" in stats["tenants"]
+        assert socket_request(
+            sock, {"op": "submit", "videos": []})["ok"] is False
+        assert socket_request(sock, {"op": "nope"})["ok"] is False
+        assert socket_request(sock, {"op": "drain"})["draining"] is True
+    finally:
+        api.stop()
+    assert svc.run() == 0
+    final = svc.status("batch-7")
+    assert final["ok"] and final["state"] == "done"
+    assert not os.path.exists(sock)  # stop() unlinks
+
+
+def test_draining_service_rejects_new_requests(tmp_path, corpus):
+    svc = _service(tmp_path, "drainrej")
+    svc.request_drain()
+    with pytest.raises(RequestRejected, match="draining"):
+        svc.submit({"videos": corpus[:1]})
+    assert svc.run() == 0
+
+
+def test_resume_skips_done_videos_at_admission(tmp_path, corpus):
+    svc = _service(tmp_path, "resume")
+    r = svc.submit({"videos": corpus[:2]})
+    svc.request_drain()
+    assert svc.run() == 0 and r.state == "done"
+    svc2 = _service(tmp_path, "resume", resume=True)
+    r2 = svc2.submit({"videos": corpus})
+    assert svc2.queue.pending() == 2  # only the two new videos queued
+    svc2.request_drain()
+    assert svc2.run() == 0
+    assert r2.state == "done" and len(r2.done) == len(corpus)
+
+
+# ---- long-run memory bound (soak) ------------------------------------------
+
+
+def test_soak_no_per_request_growth(tmp_path, corpus):
+    """A stream of requests leaves no residue: per-video packer bookkeeping,
+    request/job maps, pending writes, and finished assemblies are all empty
+    after each request completes (FeatureAssembly.release + packer.forget)."""
+    svc = _service(tmp_path, "soak")
+    sizes = []
+    for i in range(4):
+        r = svc.submit({"tenant": f"t{i % 2}", "videos": corpus,
+                        "request_id": f"soak-{i}"})
+        for _ in range(500):
+            svc.step()
+            if r.complete:
+                break
+        assert r.state == "done"
+        packer = svc.packer
+        assert not packer.has_pending()
+        sizes.append((len(packer.video_clips), len(packer._video_keys),
+                      len(packer._finished), len(svc._requests),
+                      len(svc._jobs), len(svc.ex._pending_writes),
+                      len(packer.flush_errors)))
+    assert sizes == [(0, 0, 0, 0, 0, 0, 0)] * 4
+    svc.close()
+
+
+def test_assembly_release_drops_row_buffers():
+    from video_features_tpu.io.output import FeatureAssembly
+
+    asm = FeatureAssembly("v", {})
+    asm.reserve()
+    asm.put(0, np.ones((4,), np.float32))
+    asm.finish()
+    stacked = asm.stacked((4,))
+    asm.release()
+    assert asm._rows == {} and stacked.shape == (1, 4)  # copy survives
+
+
+# ---- decode autoscaler -----------------------------------------------------
+
+
+def test_autoscaler_grows_on_starvation_shrinks_on_idle():
+    a = DecodeAutoscaler(min_workers=1, max_workers=4)
+    # starved: low occupancy AND decode dominating wall
+    assert a.decide(0.5, decode_seconds=5.0, wall_seconds=10.0,
+                    current=2, dispatched_slots=16) == 3
+    assert a.decide(0.5, 5.0, 10.0, current=4, dispatched_slots=16) == 4
+    # decode nearly free: shrink
+    assert a.decide(0.95, 0.2, 10.0, current=2, dispatched_slots=16) == 1
+    assert a.decide(0.95, 0.2, 10.0, current=1, dispatched_slots=16) == 1
+    # healthy interval or too little evidence: hold
+    assert a.decide(0.95, 3.0, 10.0, current=2, dispatched_slots=16) == 2
+    assert a.decide(0.2, 9.0, 10.0, current=2, dispatched_slots=2) == 2
+    assert a.decide(0.2, 9.0, 0.0, current=2, dispatched_slots=16) == 2
+
+
+def test_decode_pool_resize_live(tmp_path, corpus):
+    """decode_workers=0 resolves to an auto pool the daemon can resize while
+    work flows; a shrink never cancels a mid-decode video."""
+    svc = _service(tmp_path, "auto", decode_workers=0)
+    pool = svc.ex._decode_pool
+    assert pool is not None and pool.workers >= 2
+    assert svc._autoscaler is not None
+    pool.resize(pool.workers + 2)
+    grown = pool.workers
+    r = svc.submit({"videos": corpus})
+    svc.step()
+    pool.resize(1)  # shrink under load: debt, not cancellation
+    assert pool.workers == 1 < grown
+    svc.request_drain()
+    assert svc.run() == 0
+    assert r.state == "done"
+
+
+def test_serve_rejects_batch_only_flags(tmp_path):
+    cfg = _cfg(tmp_path, "vcfg", serve=True)
+    cfg.validate()  # the serve base config itself is valid
+    for kw, msg in ((dict(max_failures=3), "tenant_max_failures"),
+                    (dict(retry_failed=True), "batch-run flag"),
+                    (dict(show_pred=True, num_devices=1), "batch-only"),
+                    (dict(on_extraction="print"), "save_numpy"),
+                    (dict(spool_dir=None), "spool_dir"),
+                    (dict(decode_workers=-1), "auto")):
+        with pytest.raises(ValueError, match=msg):
+            cfg.replace(**kw).validate()
+
+
+def test_service_requires_a_packing_path(tmp_path):
+    class NoPack(ToyPacked):
+        def pack_spec(self):
+            return None
+
+    ex = NoPack(_cfg(tmp_path, "nopack", serve=True))
+    with pytest.raises(ValueError, match="packing path"):
+        ExtractionService(ex)
+
+
+# ---- signal-driven lifecycle (in-process, real daemon thread) --------------
+
+
+def test_spool_watcher_thread_feeds_a_live_daemon(tmp_path, corpus):
+    """The full daemon wiring minus signals: watcher thread ingests a spool
+    file while run() serves, a socket drain ends the run cleanly."""
+    svc = _service(tmp_path, "live", spool_poll_sec=0.01)
+    spool = svc.cfg.spool_dir
+    watcher = SpoolWatcher(spool, svc, poll_interval=0.01)
+    watcher.start()
+    runner = threading.Thread(target=lambda: setattr(
+        svc, "_rc", svc.run()), daemon=True)
+    runner.start()
+    try:
+        tmp_file = os.path.join(spool, ".r1.json.tmp")
+        with open(tmp_file, "w") as f:
+            json.dump({"tenant": "alice", "videos": corpus[:2]}, f)
+        os.replace(tmp_file, os.path.join(spool, "r1.json"))  # atomic drop
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if os.path.exists(os.path.join(svc.notify_dir, "r1.result.json")):
+                break
+            time.sleep(0.02)
+        assert _result(svc, "r1")["state"] == "done"
+    finally:
+        svc.request_drain()
+        runner.join(timeout=30)
+        watcher.stop()
+    assert svc._rc == 0
+    assert len(_outputs(tmp_path, "live")) == 4

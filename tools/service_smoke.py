@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Service smoke: spool-directory ingest → drain → manifest/output parity.
+
+The CI-runnable end-to-end check for the always-on daemon (docs/serving.md),
+driving the REAL CLI surface as an operator would — no test harness imports:
+
+1. two per-tenant batch CLI runs produce the reference outputs;
+2. a daemon subprocess (``--serve``, spool ingest, real signals) serves the
+   same videos as two tenant requests dropped into the spool;
+3. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
+   records for both requests, a complete done-manifest, and byte-identical
+   ``.npy`` outputs against the batch runs.
+
+Runs on CPU with deterministic random weights::
+
+    JAX_PLATFORMS=cpu VFT_ALLOW_RANDOM_WEIGHTS=1 python tools/service_smoke.py
+
+Exit code 0 = pass; any assertion or timeout raises.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIMEOUT = float(os.environ.get("VFT_SMOKE_TIMEOUT", "600"))
+
+
+def write_video(path, frames, size=(32, 24)):
+    import cv2
+
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), 10.0, size)
+    rng = np.random.default_rng(frames)
+    for _ in range(frames):
+        w.write(rng.integers(0, 256, (size[1], size[0], 3), dtype=np.uint8))
+    w.release()
+    return path
+
+
+def cli(out_dir, *extra):
+    return [sys.executable, os.path.join(REPO, "main.py"),
+            "--feature_type", "resnet50", "--on_extraction", "save_numpy",
+            "--batch_size", "4", "--output_path", out_dir, *extra]
+
+
+def outputs(out_dir):
+    return {os.path.basename(p): np.load(p)
+            for p in glob.glob(os.path.join(out_dir, "resnet50", "*.npy"))}
+
+
+def main() -> int:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "VFT_ALLOW_RANDOM_WEIGHTS": "1"}
+    root = tempfile.mkdtemp(prefix="vft_service_smoke_")
+    videos = {"alice": [write_video(os.path.join(root, f"a{i}.mp4"), n)
+                        for i, n in enumerate((3, 6))],
+              "bob": [write_video(os.path.join(root, f"b{i}.mp4"), n)
+                      for i, n in enumerate((5, 2))]}
+
+    print("[smoke] per-tenant batch reference runs")
+    for tenant, vids in videos.items():
+        subprocess.run(cli(os.path.join(root, f"batch_{tenant}"),
+                           "--video_paths", *vids),
+                       env=env, check=True, timeout=TIMEOUT)
+
+    spool = os.path.join(root, "spool")
+    os.makedirs(spool)
+    serve_out = os.path.join(root, "serve")
+    print("[smoke] starting the daemon")
+    daemon = subprocess.Popen(
+        cli(serve_out, "--serve", "--spool_dir", spool,
+            "--idle_flush_sec", "0.05", "--spool_poll_sec", "0.05"),
+        env=env)
+    try:
+        for tenant, vids in videos.items():
+            tmp = os.path.join(spool, f".{tenant}.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"tenant": tenant, "videos": vids}, f)
+            os.replace(tmp, os.path.join(spool, f"req_{tenant}.json"))
+
+        results = {t: os.path.join(spool, "results", f"req_{t}.result.json")
+                   for t in videos}
+        deadline = time.time() + TIMEOUT
+        while time.time() < deadline:
+            if daemon.poll() is not None:
+                raise AssertionError(
+                    f"daemon exited early with {daemon.returncode}")
+            if all(os.path.exists(p) for p in results.values()):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("timed out waiting for result records")
+
+        for tenant, path in results.items():
+            with open(path) as f:
+                record = json.load(f)
+            assert record["state"] == "done", (tenant, record)
+            assert sorted(record["done"]) == sorted(
+                os.path.abspath(v) for v in videos[tenant]), record
+            print(f"[smoke] request {tenant}: done "
+                  f"({len(record['done'])} videos)")
+
+        print("[smoke] SIGTERM → graceful drain")
+        daemon.send_signal(signal.SIGTERM)
+        assert daemon.wait(timeout=TIMEOUT) == 0, daemon.returncode
+    finally:
+        if daemon.poll() is None:
+            daemon.kill()
+            daemon.wait()
+
+    got = outputs(serve_out)
+    want = {**outputs(os.path.join(root, "batch_alice")),
+            **outputs(os.path.join(root, "batch_bob"))}
+    assert set(got) == set(want), (sorted(got), sorted(want))
+    for name in sorted(want):
+        assert got[name].tobytes() == want[name].tobytes(), \
+            f"{name}: daemon output differs from the batch run"
+    manifest = os.path.join(serve_out, "resnet50", ".done_manifest.jsonl")
+    assert sum(1 for _ in open(manifest)) == 4, "done-manifest incomplete"
+    print(f"[smoke] PASS: {len(want)} outputs byte-identical, "
+          "manifests intact")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,6 +33,8 @@ ALLOWED: Dict[str, int] = {
     "video_features_tpu/reliability/retry.py": 2,  # classified re-raise + attempts attr
     "video_features_tpu/reliability/watchdog.py": 1,  # hands the exception to the waiter
     "video_features_tpu/run.py": 1,                # best-effort JAX_PLATFORMS shim
+    "video_features_tpu/serve/daemon.py": 3,       # per-video isolation point (serving loop) + best-effort rejection/result records (the daemon must outlive a full notify disk)
+    "video_features_tpu/serve/ingest.py": 1,       # one bad socket client must not kill the API thread
 }
 
 MARKER = "fault-barrier:"

@@ -53,6 +53,10 @@ THREAD_MODULES: Dict[str, str] = {
     # see it) — declared here anyway per this rule's contract: workers return
     # values only, assembly happens on the calling thread, no shared stores
     "video_features_tpu/io/video.py": "corpus geometry probe pool (prepare)",
+    # spool-watcher + socket-API ingest threads: both publish exclusively
+    # through ExtractionService's RLock-guarded methods and the RequestQueue
+    # lock — the thread entries themselves store nothing shared
+    "video_features_tpu/serve/ingest.py": "spool watcher + socket API ingest",
 }
 
 # declared cross-thread stores: module -> {canonical site: discipline}

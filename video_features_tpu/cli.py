@@ -109,7 +109,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "features")
     parser.add_argument("--decode_workers", type=int, default=1,
                         help="background threads decoding upcoming videos while the "
-                             "device computes (frame-stream models); 1 = inline")
+                             "device computes (frame-stream models); 1 = inline; "
+                             "0 = auto (start from a CPU-derived size; the "
+                             "--serve daemon then grows/shrinks the pool live "
+                             "from the measured occupancy vs decode signal)")
     parser.add_argument("--pack_corpus", action="store_true", default=False,
                         help="corpus-level clip packing: fill every device "
                              "batch with clips from however many videos are "
@@ -191,6 +194,45 @@ def build_parser() -> argparse.ArgumentParser:
                              "default writer thread overlaps serialization "
                              "with the next video's compute, preserving "
                              "atomic writes and write-before-done ordering)")
+    # Serving flags (--serve daemon, docs/serving.md)
+    parser.add_argument("--serve", action="store_true", default=False,
+                        help="run the always-on extraction service instead "
+                             "of the batch loop: watch --spool_dir for "
+                             "per-tenant request files (+ a local-socket "
+                             "API), schedule videos weighted-fair + deadline "
+                             "across tenants, and keep the corpus packer's "
+                             "slot queues warm across requests; SIGTERM "
+                             "drains, SIGHUP reloads (docs/serving.md)")
+    parser.add_argument("--spool_dir", default=None,
+                        help="--serve: watched request directory — tenants "
+                             "drop <request_id>.json files here; "
+                             "tenants.json in the same directory sets "
+                             "per-tenant weights/quotas")
+    parser.add_argument("--socket_path", default=None,
+                        help="--serve: Unix socket for the submit/status/"
+                             "stats/drain/reload API (default: "
+                             "<spool_dir>/control.sock; 'none' disables)")
+    parser.add_argument("--notify_dir", default=None,
+                        help="--serve: directory for per-request "
+                             "<request_id>.result.json completion records "
+                             "(default: <spool_dir>/results)")
+    parser.add_argument("--tenant_quota", type=int, default=64,
+                        help="--serve: default per-tenant pending-video "
+                             "quota; submissions past it are rejected at "
+                             "admission (tenants.json overrides per tenant)")
+    parser.add_argument("--tenant_max_failures", type=int, default=None,
+                        help="--serve: per-tenant circuit breaker — once "
+                             "more than this many of a tenant's videos "
+                             "terminally failed, fail its queue fast and "
+                             "reject its submissions until SIGHUP reload "
+                             "(0 = trip on first failure; default: never)")
+    parser.add_argument("--idle_flush_sec", type=float, default=0.5,
+                        help="--serve: with the ingest queue idle, wait this "
+                             "long before pad-flushing partial slot queues "
+                             "so in-flight requests complete (latency over "
+                             "occupancy when there is nothing to pack with)")
+    parser.add_argument("--spool_poll_sec", type=float, default=0.25,
+                        help="--serve: spool directory poll interval")
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")

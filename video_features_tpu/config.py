@@ -193,6 +193,38 @@ class ExtractionConfig:
     # video list; retried entries are pruned and re-append only if they fail
     # again.
     retry_failed: bool = False
+    # --- serving knobs (--serve daemon, docs/serving.md) ---
+    # Run the always-on extraction service instead of the batch loop: watch
+    # --spool_dir for per-tenant request files (plus a local-socket API),
+    # schedule videos weighted-fair + deadline across tenants, and keep the
+    # corpus packer's slot queues warm across requests (serve/daemon.py).
+    serve: bool = False
+    # Watched request directory (required with --serve): tenants drop
+    # <request_id>.json files here; <spool_dir>/tenants.json holds per-tenant
+    # weights/quotas (SIGHUP re-reads it).
+    spool_dir: Optional[str] = None
+    # Unix socket for the submit/status/stats/drain/reload API. None =
+    # <spool_dir>/control.sock; "none" disables the socket listener.
+    socket_path: Optional[str] = None
+    # Where per-request .result.json completion records land. None =
+    # <spool_dir>/results.
+    notify_dir: Optional[str] = None
+    # Default per-tenant pending-video quota: a submission that would push a
+    # tenant past it is rejected at admission (tenants.json overrides).
+    tenant_quota: int = 64
+    # Per-tenant circuit breaker: once MORE THAN this many of a tenant's
+    # videos have terminally failed, its queued videos fail fast and new
+    # submissions are rejected until a SIGHUP reload — other tenants keep
+    # flowing. None = never trip (the batch --max_failures analogue, scoped
+    # to one tenant instead of the run).
+    tenant_max_failures: Optional[int] = None
+    # Idle flush latency: with the ingest queue empty and partial slot
+    # queues pending, wait this long for more work before pad-flushing so
+    # in-flight requests complete (latency over occupancy when there is
+    # nothing to pack with).
+    idle_flush_sec: float = 0.5
+    # Spool directory poll interval.
+    spool_poll_sec: float = 0.25
     # I3D geometry: smaller-edge resize target and center-crop size. The
     # reference hard-codes 256/224 (extract_i3d.py:25 + transforms); these stay
     # the parity defaults. Overriding shrinks the SAME jitted two-stream
@@ -239,8 +271,11 @@ class ExtractionConfig:
             raise ValueError("pwc_warp must be auto|gather|onehot")
         if self.matmul_precision not in (None, "default", "high", "highest"):
             raise ValueError("matmul_precision must be default|high|highest")
-        if self.decode_workers < 1:
-            raise ValueError("decode_workers must be >= 1")
+        if self.decode_workers < 0:
+            raise ValueError("decode_workers must be >= 1, or 0 for auto "
+                             "(start small; the --serve daemon resizes the "
+                             "pool live from the measured decode-starvation "
+                             "signal)")
         if self.pack_buckets < 1:
             raise ValueError("pack_buckets must be >= 1")
         if self.pack_flush_age < 0:
@@ -281,6 +316,36 @@ class ExtractionConfig:
                   file=sys.stderr)
         if self.i3d_pre_crop_size < self.i3d_crop_size:
             raise ValueError("i3d_pre_crop_size must be >= i3d_crop_size")
+        if self.tenant_quota < 1:
+            raise ValueError("tenant_quota must be >= 1")
+        if self.tenant_max_failures is not None and self.tenant_max_failures < 0:
+            raise ValueError("tenant_max_failures must be >= 0 (0 = trip on "
+                             "the first failure)")
+        if self.idle_flush_sec < 0:
+            raise ValueError("idle_flush_sec must be >= 0")
+        if self.spool_poll_sec <= 0:
+            raise ValueError("spool_poll_sec must be > 0")
+        if self.serve:
+            if not self.spool_dir:
+                raise ValueError("--serve requires --spool_dir (the watched "
+                                 "request directory)")
+            if self.on_extraction != "save_numpy":
+                raise ValueError("--serve requires --on_extraction "
+                                 "save_numpy: the service's product is saved "
+                                 "features plus per-request result records")
+            if self.retry_failed:
+                raise ValueError("--retry_failed is a batch-run flag; the "
+                                 "--serve daemon re-enqueues transient "
+                                 "failures through its scheduler instead")
+            if self.max_failures is not None:
+                raise ValueError("--max_failures aborts the whole RUN — a "
+                                 "policy that crosses tenant boundaries; "
+                                 "use --tenant_max_failures, the per-tenant "
+                                 "breaker, with --serve")
+            if self.show_pred:
+                raise ValueError("--show_pred is batch-only (per-batch "
+                                 "prints assume video order; no packing "
+                                 "path)")
 
     def replace(self, **kw) -> "ExtractionConfig":
         return dataclasses.replace(self, **kw)

@@ -83,7 +83,11 @@ class Extractor(abc.ABC):
         # per-video stage clock; active only when metrics are enabled (run())
         self.clock: Optional[StageClock] = None
         # cross-video decode pool; created by run() when --decode_workers > 1
+        # (0 = auto: _resolve_decode_workers picks the start size and the
+        # serving daemon resizes it live); _decode_workers is the resolved
+        # pool size the run loops use as their schedule-ahead window
         self._decode_pool: Optional[DecodePrefetcher] = None
+        self._decode_workers = max(cfg.decode_workers, 1)
         # async output writer; created by run() for save_numpy jobs unless
         # --sync_writer opted out. _pending_writes holds (path, WriteHandle)
         # for extractions whose output is still on the writer thread — on
@@ -199,7 +203,34 @@ class Extractor(abc.ABC):
                       "packing path under this config (--show_pred debug "
                       "runs and the single-clip frame-sharded flow sandwich "
                       "use the per-video loop)")
+        self._open_run_resources()
+        try:
+            if pack is not None:
+                return self._run_packed(pack, paths, done, with_metrics, progress)
+            return self._run_loop(paths, done, with_metrics, progress)
+        finally:
+            self._close_run_resources()
+
+    def _resolve_decode_workers(self) -> int:
+        """``--decode_workers 0`` = auto (ROADMAP item 4, first step).
+
+        Starts from a modest CPU-derived pool; the serving daemon then grows
+        or shrinks it live from the measured occupancy / decode-MB/s signal
+        (:mod:`..serve.autoscale`). Batch runs keep the initial value — they
+        have no between-request boundary to resize at.
+        """
         workers = self.cfg.decode_workers
+        if workers == 0:
+            workers = min(4, max(2, (os.cpu_count() or 2) // 2))
+            print(f"--decode_workers 0 (auto): starting the decode pool at "
+                  f"{workers} worker(s)")
+        return workers
+
+    def _open_run_resources(self) -> None:
+        """Decode pool + async writer + per-run accounting, shared by
+        :meth:`run` and the serving daemon's caller-managed session."""
+        workers = self._resolve_decode_workers()
+        self._decode_workers = workers
         if workers > 1 and self.uses_frame_stream:
             self._decode_pool = DecodePrefetcher(self._open_inline, workers)
         elif workers > 1:
@@ -216,33 +247,31 @@ class Extractor(abc.ABC):
                 depth=2,
                 retry=RetryPolicy(attempts=self.cfg.retries + 1,
                                   base_delay=self.cfg.retry_backoff))
-        self._succeeded: List[str] = []  # pruned from the failure manifest at exit
+        self._succeeded = []  # pruned from the failure manifest at exit
         self._ok = 0
         self._failures = 0
-        try:
-            if pack is not None:
-                return self._run_packed(pack, paths, done, with_metrics, progress)
-            return self._run_loop(paths, done, with_metrics, progress)
-        finally:
-            # KeyboardInterrupt / a raising progress callback must not leak
-            # decode workers busy-waiting on full queues — shut the pool down
-            # FIRST so a raising manifest prune can't skip it
-            if self._decode_pool is not None:
-                self._decode_pool.shutdown()
-                self._decode_pool = None
-            # drain the writer even on interrupt/breaker: queued jobs finish
-            # their atomic writes + done records (write-before-done holds),
-            # then account the drained handles so videos that DID complete
-            # reach _succeeded (their stale failure records must be pruned —
-            # a --retry_failed pass interrupted after its last extract would
-            # otherwise leave a video in both manifests forever)
-            if self._writer is not None:
-                self._writer.close(wait=True)
-                self._writer = None
-                self._reap_abandoned_writes()
-            # even on KeyboardInterrupt / circuit breaker: converge the failure
-            # manifest for everything that DID succeed this run
-            self._prune_succeeded(self._succeeded)
+
+    def _close_run_resources(self) -> None:
+        """Unwind-safe teardown (run()'s ``finally`` and the daemon's)."""
+        # KeyboardInterrupt / a raising progress callback must not leak
+        # decode workers busy-waiting on full queues — shut the pool down
+        # FIRST so a raising manifest prune can't skip it
+        if self._decode_pool is not None:
+            self._decode_pool.shutdown()
+            self._decode_pool = None
+        # drain the writer even on interrupt/breaker: queued jobs finish
+        # their atomic writes + done records (write-before-done holds),
+        # then account the drained handles so videos that DID complete
+        # reach _succeeded (their stale failure records must be pruned —
+        # a --retry_failed pass interrupted after its last extract would
+        # otherwise leave a video in both manifests forever)
+        if self._writer is not None:
+            self._writer.close(wait=True)
+            self._writer = None
+            self._reap_abandoned_writes()
+        # even on KeyboardInterrupt / circuit breaker: converge the failure
+        # manifest for everything that DID succeed this run
+        self._prune_succeeded(self._succeeded)
 
     def _process_one(self, path: str,
                      cancelled: Optional[threading.Event] = None,
@@ -408,7 +437,7 @@ class Extractor(abc.ABC):
                 "--retry_failed."
             ) from e
 
-    def _reap_writes(self, limit: int) -> None:
+    def _reap_writes(self, limit: int, on_done=None, on_failed=None) -> None:
         """Resolve oldest pending writes until ≤ ``limit`` remain.
 
         Peek-then-pop: a KeyboardInterrupt inside ``handle.wait()``
@@ -416,6 +445,11 @@ class Extractor(abc.ABC):
         deque so the shutdown drain (:meth:`_reap_abandoned_writes`) can
         still account the write — a popped-then-lost handle would strand
         its video's stale failure record forever.
+
+        ``on_done(path)`` / ``on_failed(path, exc)``: the serving daemon's
+        per-request bookkeeping hooks. A truthy ``on_failed`` return claims
+        the failure (the daemon re-enqueued the video); the shared terminal
+        accounting then does not run.
         """
         pending_writes = self._pending_writes
         while len(pending_writes) > limit:
@@ -426,15 +460,19 @@ class Extractor(abc.ABC):
                 raise
             except Exception as e:  # noqa: BLE001 — fault-barrier: the write-side arm of the per-video isolation point
                 pending_writes.popleft()
+                if on_failed is not None and on_failed(wpath, e):
+                    continue
                 self._fail(wpath, e)
                 continue
             pending_writes.popleft()
             self._ok += 1
             self._succeeded.append(wpath)
+            if on_done is not None:
+                on_done(wpath)
 
     def _run_loop(self, paths, done, with_metrics, progress) -> int:
         todo = [p for p in paths if os.path.abspath(p) not in done]
-        workers = self.cfg.decode_workers
+        workers = self._decode_workers
         extracted = 0  # excludes resume-skipped videos (throughput honesty)
         resumed = 0  # tracked directly: ok - extracted no longer equals it
         # when an async write fails (extracted counts the successful extract,
@@ -520,10 +558,8 @@ class Extractor(abc.ABC):
         thread still trips it, but a hard-wedged inline decode needs the
         per-video loop's thread-cancelling watchdog.
         """
-        from ..parallel.packer import CorpusPacker
-
         todo = [p for p in paths if os.path.abspath(p) not in done]
-        workers = self.cfg.decode_workers
+        workers = self._decode_workers
         extracted = 0
         resumed = 0
         cursor = 0  # decode-window cursor over `todo`
@@ -532,73 +568,10 @@ class Extractor(abc.ABC):
             # clustering over container probes) before any decode starts
             spec.prepare(todo)
         self.clock = StageClock() if with_metrics else None  # corpus-level
-        packer = CorpusPacker(spec, wait=self._wait, clock=self.clock,
-                              flush_age=self.cfg.pack_flush_age)
-        pending_writes = self._pending_writes
-        pending_writes.clear()
-        timeout = self.cfg.video_timeout
+        session = PackedSession(self, spec)
+        packer = session.packer
+        self._pending_writes.clear()
         t_run = time.perf_counter()
-
-        def drain_stream(path: str) -> None:
-            """One attempt at one video: pack every clip of its stream."""
-            deadline = (time.perf_counter() + timeout) if timeout else None
-            fault_point("extract", path)
-            info, clips = spec.open_clips(path)
-            packer.begin(path, info)
-            try:
-                for clip in clips:
-                    packer.add(path, clip)
-                    if deadline is not None and time.perf_counter() > deadline:
-                        raise VideoTimeoutError(
-                            f"{path}: packed clip stream exceeded "
-                            f"--video_timeout ({timeout:.3g}s); failing this "
-                            f"video")
-            finally:
-                # an abandoned generator's cleanup (temp-wav deletion, capture
-                # release) must run before any retry re-opens the same path,
-                # not whenever GC collects the frame
-                close = getattr(clips, "close", None)
-                if close is not None:
-                    close()
-            packer.finish(path)
-
-        def attempt_with_retries(path: str) -> None:
-            def on_retry(exc, attempt, delay):
-                err_class, _ = classify(exc)
-                print(f"[{err_class}] attempt {attempt} failed for {path}: "
-                      f"{exc}; retrying in {delay:.2g}s")
-                # the retry decodes fresh and repacks from clip 0: the failed
-                # attempt's queued/dispatched slots are orphaned by discard()
-                packer.discard(path)
-                if self._decode_pool is not None:
-                    self._decode_pool.release(path)
-
-            retry_call(
-                lambda: drain_stream(path),
-                RetryPolicy(attempts=self.cfg.retries + 1,
-                            base_delay=self.cfg.retry_backoff),
-                on_retry=on_retry,
-            )
-
-        def emit_completed() -> None:
-            """Finalize every video whose last clip's features have landed."""
-            for asm in packer.pop_completed():
-                try:
-                    feats = spec.finalize(asm.video,
-                                          asm.stacked(spec.empty_row_shape),
-                                          asm.info)
-                    handle = self._submit_outputs(asm.video, feats)
-                except KeyboardInterrupt:
-                    raise
-                except Exception as e:  # noqa: BLE001 — fault-barrier: the finalize/write arm of the packed per-video isolation point
-                    self._fail(asm.video, e)
-                    continue
-                if handle is not None:
-                    pending_writes.append((asm.video, handle))
-                else:
-                    self._ok += 1
-                    self._succeeded.append(asm.video)
-            self._reap_writes(1)
 
         with maybe_profiler(self.cfg.profile_dir):
             for n, path in enumerate(paths, start=1):
@@ -613,48 +586,19 @@ class Extractor(abc.ABC):
                         self._decode_pool.schedule(p)
                     cursor += 1
                 try:
-                    attempt_with_retries(path)
+                    session.ingest(path)
                     extracted += 1
                 except KeyboardInterrupt:
                     raise
                 except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point (packed loop)
-                    packer.discard(path)
-                    self._fail(path, e)
+                    session.fail(path, e)
                 finally:
                     if self._decode_pool is not None:
                         self._decode_pool.release(path)
-                emit_completed()
+                session.emit_completed()
                 if progress:
                     progress(n, len(paths))
-            flush_error = None
-            try:
-                # dispatch partial shape queues (zero-padded tails) and
-                # resolve the final in-flight batches — tail-batch device
-                # failures are contained per bucket inside flush() and
-                # surface as flush_causes on the drained victims; this
-                # except is a safety net for non-dispatch failures
-                packer.flush()
-            except KeyboardInterrupt:
-                raise
-            except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point
-                flush_error = e
-            emit_completed()
-            for asm in packer.drain_incomplete():
-                # rows lost to a failed co-packed batch (mid-run, at a stale
-                # flush, or at the corpus flush): fail each contributing
-                # video so it lands in the failure manifest (DeviceError is
-                # transient — a --retry_failed pass reprocesses exactly
-                # these) instead of crashing the run or silently denting the
-                # return value
-                causes = packer.flush_causes(asm.video)
-                if flush_error is not None:
-                    causes.append(str(flush_error))
-                cause = f": {'; '.join(causes)}" if causes else ""
-                self._fail(asm.video, DeviceError(
-                    f"{asm.video}: a co-packed device batch failed before "
-                    f"this video's clips resolved{cause}; rerun with "
-                    "--retry_failed"))
-            self._reap_writes(0)
+            session.drain(final=True)
         self._pack_stats = {
             "real_slots": packer.real_slots,
             "dispatched_slots": packer.dispatched_slots,
@@ -683,6 +627,191 @@ class Extractor(abc.ABC):
                   f"({resumed} resumed) in {dt:.2f}s")
         self.clock = None
         return self._ok
+
+
+class PackedSession:
+    """A live packed run: one :class:`..parallel.packer.CorpusPacker` plus the
+    per-video ingest → finalize → write machinery that used to live inline in
+    :meth:`Extractor._run_packed`.
+
+    Factored out so the run loop is *resumable against a live queue*: the
+    batch CLI creates one session per ``run()`` and calls :meth:`drain` after
+    the last video, while the serving daemon (:mod:`..serve`) keeps ONE
+    session alive for its whole lifetime — slot queues stay warm across
+    requests, :meth:`ingest` is called per scheduled video in whatever order
+    the tenant scheduler decides, and :meth:`drain` runs only at queue-idle
+    flushes and graceful shutdown.
+
+    ``on_done(path)`` / ``on_failed(path, exc)`` fire after the shared
+    accounting (done/failure manifests, counters) — the daemon's per-request
+    and per-tenant bookkeeping. ``forget_completed=True`` additionally drops
+    the packer's per-video stats as each video resolves, bounding memory over
+    an unbounded request stream (batch runs keep them for ``_pack_stats``).
+    """
+
+    def __init__(self, ex: Extractor, spec, on_done=None, on_failed=None,
+                 forget_completed: bool = False):
+        from ..parallel.packer import CorpusPacker
+
+        self.ex = ex
+        self.spec = spec
+        self.packer = CorpusPacker(spec, wait=ex._wait, clock=ex.clock,
+                                   flush_age=ex.cfg.pack_flush_age)
+        self._on_done = on_done
+        self._on_failed = on_failed
+        self._forget = forget_completed
+
+    # --- ingest ---------------------------------------------------------------
+
+    def ingest(self, path: str, retries: Optional[int] = None) -> None:
+        """Drain one video's clip stream into the packer.
+
+        ``retries`` bounds IN-PLACE re-attempts (None = the config budget;
+        the daemon passes 0 and re-enqueues transient failures through its
+        scheduler instead of sleeping backoffs in the serving hot loop).
+        Raises on terminal failure — the caller owns the fault barrier and
+        must then call :meth:`fail` (or re-enqueue after ``packer.discard``).
+        """
+        ex = self.ex
+        if retries is None:
+            retries = ex.cfg.retries
+
+        def on_retry(exc, attempt, delay):
+            err_class, _ = classify(exc)
+            print(f"[{err_class}] attempt {attempt} failed for {path}: "
+                  f"{exc}; retrying in {delay:.2g}s")
+            # the retry decodes fresh and repacks from clip 0: the failed
+            # attempt's queued/dispatched slots are orphaned by discard()
+            self.packer.discard(path)
+            if ex._decode_pool is not None:
+                ex._decode_pool.release(path)
+
+        retry_call(
+            lambda: self._drain_stream(path),
+            RetryPolicy(attempts=retries + 1,
+                        base_delay=ex.cfg.retry_backoff),
+            on_retry=on_retry,
+        )
+
+    def _drain_stream(self, path: str) -> None:
+        """One attempt at one video: pack every clip of its stream."""
+        timeout = self.ex.cfg.video_timeout
+        packer = self.packer
+        deadline = (time.perf_counter() + timeout) if timeout else None
+        fault_point("extract", path)
+        info, clips = self.spec.open_clips(path)
+        packer.begin(path, info)
+        try:
+            for clip in clips:
+                packer.add(path, clip)
+                if deadline is not None and time.perf_counter() > deadline:
+                    raise VideoTimeoutError(
+                        f"{path}: packed clip stream exceeded "
+                        f"--video_timeout ({timeout:.3g}s); failing this "
+                        f"video")
+        finally:
+            # an abandoned generator's cleanup (temp-wav deletion, capture
+            # release) must run before any retry re-opens the same path,
+            # not whenever GC collects the frame
+            close = getattr(clips, "close", None)
+            if close is not None:
+                close()
+        packer.finish(path)
+
+    def fail(self, path: str, e: BaseException) -> None:
+        """Terminal per-video failure: orphan its slots, run the accounting."""
+        self.packer.discard(path)
+        self._video_failed(path, e)
+
+    # --- results --------------------------------------------------------------
+
+    def emit_completed(self, reap_limit: int = 1) -> None:
+        """Finalize every video whose last clip's features have landed."""
+        ex = self.ex
+        for asm in self.packer.pop_completed():
+            try:
+                feats = self.spec.finalize(
+                    asm.video, asm.stacked(self.spec.empty_row_shape),
+                    asm.info)
+                handle = ex._submit_outputs(asm.video, feats)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the finalize/write arm of the packed per-video isolation point
+                asm.release()
+                self._video_failed(asm.video, e)
+                self._forget_video(asm.video)
+                continue
+            # rows are views into whole fetched batches; finalize copied
+            # what it needed, so release them now (long-run memory bound)
+            asm.release()
+            if handle is not None:
+                ex._pending_writes.append((asm.video, handle))
+            else:
+                ex._ok += 1
+                ex._succeeded.append(asm.video)
+                if self._on_done is not None:
+                    self._on_done(asm.video)
+            self._forget_video(asm.video)
+        ex._reap_writes(reap_limit, on_done=self._on_done,
+                        on_failed=self._on_failed)
+
+    def drain(self, final: bool = False) -> None:
+        """Dispatch partial shape queues (zero-padded tails), resolve the
+        in-flight batches, and fail the videos whose rows a co-packed batch
+        failure lost.
+
+        The batch loop calls this once after the last video (``final=True``
+        also reaps every pending write); the daemon calls it with
+        ``final=False`` whenever the ingest queue goes idle — latency over
+        occupancy when there is nothing left to pack with — and once more at
+        graceful shutdown.
+        """
+        packer = self.packer
+        flush_error = None
+        try:
+            # tail-batch device failures are contained per bucket inside
+            # flush() and surface as flush_causes on the drained victims;
+            # this except is a safety net for non-dispatch failures
+            packer.flush()
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point
+            flush_error = e
+        self.emit_completed(reap_limit=0 if final else 1)
+        for asm in packer.drain_incomplete():
+            # rows lost to a failed co-packed batch (mid-run, at a stale
+            # flush, or at this flush): fail each contributing video so it
+            # lands in the failure manifest (DeviceError is transient — a
+            # --retry_failed pass reprocesses exactly these) instead of
+            # crashing the run or silently denting the return value
+            causes = packer.flush_causes(asm.video)
+            if flush_error is not None:
+                causes.append(str(flush_error))
+            cause = f": {'; '.join(causes)}" if causes else ""
+            asm.release()
+            self._video_failed(asm.video, DeviceError(
+                f"{asm.video}: a co-packed device batch failed before "
+                f"this video's clips resolved{cause}; rerun with "
+                "--retry_failed"))
+            self._forget_video(asm.video)
+        packer.clear_flush_causes()
+
+    # --- shared accounting ----------------------------------------------------
+
+    def _video_failed(self, path: str, e: BaseException) -> None:
+        # the daemon's hook runs FIRST: _fail may raise CircuitBreakerTripped
+        # (batch-mode --max_failures) and the request bookkeeping must not be
+        # skipped by the unwind. A truthy return CLAIMS the failure — the
+        # daemon re-enqueues a transient victim (a co-packed batch failure,
+        # a failed async write) through its scheduler instead of recording a
+        # terminal failure here.
+        if self._on_failed is not None and self._on_failed(path, e):
+            return
+        self.ex._fail(path, e)
+
+    def _forget_video(self, path: str) -> None:
+        if self._forget:
+            self.packer.forget(path)
 
 
 def pad_batch(arr: np.ndarray, batch_size: int) -> np.ndarray:

@@ -173,6 +173,17 @@ class FeatureAssembly:
             return np.zeros((0,) + tuple(empty_row_shape), dtype)
         return np.stack([self._rows[i] for i in range(self.expected)])
 
+    def release(self) -> None:
+        """Drop the per-clip row buffers once :meth:`stacked` was consumed.
+
+        Each row is a VIEW into the device batch's fetched host array, so a
+        lingering assembly pins whole ``(batch_size, …)`` batches — on a
+        long-lived serving daemon that is unbounded growth. The run loop
+        releases every assembly right after finalize (success or failure);
+        :meth:`stacked`'s ``np.stack`` copied the data, so outputs are safe.
+        """
+        self._rows.clear()
+
 
 class WriteHandle:
     """Completion token for one video's asynchronous output write."""
@@ -285,6 +296,40 @@ class AsyncOutputWriter:
         self._q.put(None)
         if wait:
             self._thread.join()
+
+
+def request_result_path(notify_dir: str, request_id: str) -> str:
+    """Completion-notification file for one service request
+    (:mod:`..serve`): submitters poll for it instead of tailing logs."""
+    return os.path.join(notify_dir, f"{request_id}.result.json")
+
+
+def write_request_result(notify_dir: str, request_id: str,
+                         record: Mapping) -> str:
+    """Atomically write a request's per-request done/failed manifest.
+
+    One JSON document per request: terminal state, the per-video ``done``
+    list and classified ``failed`` records. Written via tmp + ``os.replace``
+    like every other output — a submitter that sees the file sees a complete
+    record. Returns the path written.
+    """
+    path = request_result_path(notify_dir, request_id)
+    tmp = path + ".tmp"
+    try:
+        os.makedirs(notify_dir, exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(dict(record), f, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+    except OSError as e:
+        raise OutputError(
+            f"failed to write request result {path}: {e}") from e
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return path
 
 
 def manifest_path(output_path: str) -> str:

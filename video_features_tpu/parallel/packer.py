@@ -39,6 +39,15 @@ cross-thread store therefore stays inside the already-declared
 ``parallel/pipeline.py`` / ``io/output.py`` seams (vftlint
 ``thread-shared-state``), and the packer itself needs no locks.
 
+The packer makes NO corpus-end assumption: :meth:`flush` drains the partial
+queues whenever the caller decides (the batch loop calls it once after the
+last video; the serving daemon — :mod:`..serve` — calls it when the ingest
+queue goes idle and again at graceful drain) and the queues keep accepting
+slots afterwards, so one packer instance serves a daemon's whole lifetime
+with the tail of request N packing into the head of request N+1. Long-run
+callers bound per-video bookkeeping with :meth:`forget` and clear consumed
+flush causes with :meth:`clear_flush_causes`.
+
 Fault attribution is slot-level, not batch-level: a poisoned clip stream
 fails only its contributing video. Slots reference their attempt's assembly
 object directly (not the video path), so a retry opens a fresh assembly and
@@ -269,6 +278,16 @@ class CorpusPacker:
         self._videos_finished += 1
         self._flush_stale()
 
+    def forget(self, path: str) -> None:
+        """Drop a COMPLETED video's bookkeeping (clip counts, bucket keys).
+
+        Batch runs keep these for the end-of-run stats; the serving daemon
+        calls this after each video's output lands so the per-video dicts
+        stay bounded over an unbounded request stream (the soak test in
+        tests/test_service.py pins this)."""
+        self.video_clips.pop(path, None)
+        self._video_keys.pop(path, None)
+
     def discard(self, path: str) -> None:
         """Drop every trace of ``path``'s current attempt (failure/retry).
 
@@ -397,6 +416,19 @@ class CorpusPacker:
         out = [a for a in self._finished if not a.complete]
         self._finished = [a for a in self._finished if a.complete]
         return out
+
+    def clear_flush_causes(self) -> None:
+        """Reset recorded flush failures once their victims were attributed.
+
+        A long-lived packer (the serving daemon) must not blame a video that
+        joins a bucket *tomorrow* with a flush failure that already failed
+        its victims today."""
+        self.flush_errors.clear()
+
+    def has_pending(self) -> bool:
+        """True while any slot is queued or any dispatched batch is unfetched
+        — the daemon's 'an idle flush would do work' signal."""
+        return (any(self._pending.values()) or bool(self._inflight))
 
     def flush_causes(self, path: str) -> List[str]:
         """Flush-failure messages (anti-starvation or corpus-end) for the

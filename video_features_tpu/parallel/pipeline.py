@@ -136,6 +136,47 @@ class DecodePrefetcher:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._sem = threading.Semaphore(workers)
+        # live resize (serve/autoscale.py): permits added via release(),
+        # removed by non-blocking acquires — shortfall becomes _debt that
+        # finishing workers absorb instead of re-releasing their permit
+        self._workers = workers
+        self._resize_lock = threading.Lock()
+        self._debt = 0
+
+    @property
+    def workers(self) -> int:
+        """Current concurrency target (also the run loops' schedule window)."""
+        return self._workers
+
+    def resize(self, workers: int) -> None:
+        """Grow or shrink the concurrent-decode budget without a restart.
+
+        Growing releases permits immediately; shrinking takes free permits
+        now and records the remainder as debt consumed as busy workers
+        finish (a mid-decode video is never cancelled by a shrink).
+        """
+        if workers < 1:
+            raise ValueError("decode workers must be >= 1")
+        with self._resize_lock:
+            delta = workers - self._workers
+            self._workers = workers
+            if delta > 0:
+                for _ in range(delta):
+                    if self._debt:
+                        self._debt -= 1
+                    else:
+                        self._sem.release()
+            else:
+                for _ in range(-delta):
+                    if not self._sem.acquire(blocking=False):
+                        self._debt += 1
+
+    def _release_permit(self) -> None:
+        with self._resize_lock:
+            if self._debt:
+                self._debt -= 1
+            else:
+                self._sem.release()
 
     def schedule(self, path: str) -> None:
         """Start decoding ``path`` in the background (no-op if scheduled)."""
@@ -160,7 +201,8 @@ class DecodePrefetcher:
         def stopped() -> bool:
             return self._stop.is_set() or slot["stop"].is_set()
 
-        with self._sem:  # at most `workers` videos decoding concurrently
+        self._sem.acquire()  # at most `workers` videos decoding concurrently
+        try:
             try:
                 if stopped():
                     return
@@ -206,6 +248,10 @@ class DecodePrefetcher:
                         break
                     except queue.Full:  # consumer will drain; retry
                         continue
+        finally:
+            # a shrink may have pre-claimed this permit as debt; the helper
+            # settles debt before returning the permit to the pool
+            self._release_permit()
 
     def get(self, path: str):
         """(meta, frames_iter) for ``path`` — prefetched if scheduled, else

@@ -10,6 +10,7 @@ layer raises the taxonomy, :mod:`..extractors.base` runs the barrier, and
 ``reliability/faults.py`` injects failures so tests can prove the loop end to end.
 """
 
+from .breaker import TenantBreaker, TenantBreakerOpen
 from .errors import (
     CircuitBreakerTripped,
     DecodeError,
@@ -34,6 +35,8 @@ from .watchdog import run_with_timeout
 
 __all__ = [
     "CircuitBreakerTripped",
+    "TenantBreaker",
+    "TenantBreakerOpen",
     "DecodeError",
     "DeviceError",
     "ExtractionError",

@@ -1,0 +1,75 @@
+"""Per-tenant circuit breaker for the always-on extraction service.
+
+The batch CLI's ``--max_failures`` breaker (:class:`.errors.CircuitBreakerTripped`)
+aborts the *run* — correct for a finite corpus with one owner, wrong for a
+daemon multiplexing tenants: one tenant uploading a directory of corrupt
+containers must not take the service down for everyone else. This breaker
+scopes the same idea to a tenant: once MORE THAN ``max_failures`` of a
+tenant's videos have terminally failed, that tenant's breaker opens — the
+daemon fails its queued videos fast (classified, manifested) and rejects its
+new submissions — while every other tenant keeps flowing. A SIGHUP reload
+(or an explicit :meth:`reset`) closes breakers again, the operator's
+"cause fixed, let them back in" lever.
+
+Single-threaded by design: the daemon's scheduler loop owns all mutation
+(submission-side reads happen under the ingest queue's lock, which the
+daemon also holds while recording failures).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+
+class TenantBreakerOpen(Exception):
+    """A tenant's breaker is open; raised at admission, never per-video.
+
+    Outside the :class:`.errors.ExtractionError` taxonomy for the same
+    reason ``CircuitBreakerTripped`` is: it is a policy decision, not a
+    per-video fault, and must never be retried.
+    """
+
+
+class TenantBreaker:
+    """Count terminal per-video failures per tenant; trip past a threshold.
+
+    ``max_failures=None`` never trips (the default, mirroring the batch
+    flag); ``0`` trips on the first terminal failure.
+    """
+
+    def __init__(self, max_failures: Optional[int] = None):
+        if max_failures is not None and max_failures < 0:
+            raise ValueError("max_failures must be >= 0 (0 = trip on the "
+                             "first failure)")
+        self.max_failures = max_failures
+        self._failures: Dict[str, int] = {}
+        self._open: set = set()
+
+    def record_failure(self, tenant: str) -> bool:
+        """Count one terminal failure; True exactly when this one TRIPS the
+        breaker (the daemon then drains the tenant's queue once)."""
+        self._failures[tenant] = self._failures.get(tenant, 0) + 1
+        if (self.max_failures is not None
+                and tenant not in self._open
+                and self._failures[tenant] > self.max_failures):
+            self._open.add(tenant)
+            return True
+        return False
+
+    def tripped(self, tenant: str) -> bool:
+        return tenant in self._open
+
+    def failures(self, tenant: str) -> int:
+        return self._failures.get(tenant, 0)
+
+    def open_tenants(self) -> Iterable[str]:
+        return sorted(self._open)
+
+    def reset(self, tenant: Optional[str] = None) -> None:
+        """Close breakers (all tenants, or one) and zero their counts."""
+        if tenant is None:
+            self._failures.clear()
+            self._open.clear()
+        else:
+            self._failures.pop(tenant, None)
+            self._open.discard(tenant)

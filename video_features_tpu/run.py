@@ -14,6 +14,10 @@ in the failure manifest, reprocess with ``--retry_failed``) or the video list wa
 empty; 2 — the run aborted before processing the full list: the ``--max_failures``
 circuit breaker tripped, or the invocation was invalid (``--retry_failed`` on a
 multi-host job; argparse flag errors also exit 2). See docs/reliability.md.
+
+``--serve`` runs the always-on extraction service instead (ingest queue,
+tenant scheduler, continuous-batching daemon — docs/serving.md): exit 0 after
+a clean drain, 1 when some videos terminally failed, 2 on invalid invocation.
 """
 
 import os
@@ -43,6 +47,14 @@ def _honor_jax_platforms_env() -> None:
 def main(argv=None) -> int:
     _honor_jax_platforms_env()
     cfg = parse_args(argv)
+
+    if cfg.serve:
+        # the always-on extraction service (docs/serving.md): single-host by
+        # design — the spool/socket ingest and the shared manifests assume
+        # one process owns the output tree
+        from video_features_tpu.serve import serve
+
+        return serve(cfg)
 
     # Multi-host bootstrap (DCN): must precede the first device access so every
     # process sees the global topology; no-op on single-host jobs.

@@ -1,0 +1,29 @@
+"""Always-on extraction service (``--serve`` / ``python -m …serve``).
+
+The batch CLI runs to completion; this package wraps the same extractors
+and corpus packer in a long-lived daemon: an ingest layer (spool directory +
+local socket API, :mod:`.ingest`) enqueues per-tenant requests, an
+admission/scheduling layer (:mod:`.scheduler`) with quotas and weighted-fair
++ deadline ordering decides whose video feeds the packer's warm slot queues
+next, and a lifecycle layer (:mod:`.daemon`) handles graceful drain and
+SIGHUP reload. docs/serving.md is the runbook.
+"""
+
+from .autoscale import DecodeAutoscaler
+from .daemon import ExtractionService, serve
+from .ingest import SocketAPI, SpoolWatcher, socket_request
+from .request import RequestRejected, ServiceRequest, parse_request
+from .scheduler import RequestQueue
+
+__all__ = [
+    "DecodeAutoscaler",
+    "ExtractionService",
+    "RequestQueue",
+    "RequestRejected",
+    "ServiceRequest",
+    "SocketAPI",
+    "SpoolWatcher",
+    "parse_request",
+    "serve",
+    "socket_request",
+]

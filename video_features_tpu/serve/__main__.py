@@ -1,0 +1,8 @@
+"""``python -m video_features_tpu.serve`` — run the extraction daemon."""
+
+import sys
+
+from .daemon import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,58 @@
+"""Decode-pool autoscaling from the PR 5 decode-starvation signal.
+
+ROADMAP item 4, first step: ``--decode_workers 0`` means *auto*. The packed
+stage report already measures the two numbers that matter — packing
+occupancy (real clips per dispatched device slot) and host seconds blocked
+on decode — and :func:`..utils.metrics.decode_starvation_warning` already
+encodes the diagnosis. This module acts on it: between requests the daemon
+feeds the interval's deltas to :meth:`DecodeAutoscaler.decide`, which grows
+the pool by one when the interval was decode-starved (padding burned while
+the host sat in the frame stream) and shrinks by one when decode was nearly
+free (idle worker threads + their buffered frames are host RAM someone else
+could use). One step per decision keeps the loop stable — the signal is
+noisy per-interval, and the pool resize itself perturbs the next interval.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.metrics import STARVED_DECODE_FRACTION, STARVED_OCCUPANCY
+
+# decode below this fraction of interval wall = the pool is oversized
+IDLE_DECODE_FRACTION = 0.1
+# ignore intervals too small to diagnose (one short request, noise)
+MIN_INTERVAL_SLOTS = 4
+
+
+class DecodeAutoscaler:
+    """Pure decision function + bounds; the daemon owns the measurement."""
+
+    def __init__(self, min_workers: int = 1,
+                 max_workers: Optional[int] = None):
+        if max_workers is None:
+            max_workers = max(min_workers, os.cpu_count() or 4)
+        if not (1 <= min_workers <= max_workers):
+            raise ValueError("need 1 <= min_workers <= max_workers")
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+
+    def decide(self, occupancy: float, decode_seconds: float,
+               wall_seconds: float, current: int,
+               dispatched_slots: int = MIN_INTERVAL_SLOTS) -> int:
+        """New pool size for the next interval.
+
+        ``occupancy``/``decode_seconds``/``wall_seconds``/``dispatched_slots``
+        are THIS interval's deltas, not run totals — an old starved interval
+        must not keep growing a pool that already caught up.
+        """
+        if wall_seconds <= 0 or dispatched_slots < MIN_INTERVAL_SLOTS:
+            return current
+        decode_fraction = decode_seconds / wall_seconds
+        if (occupancy < STARVED_OCCUPANCY
+                and decode_fraction >= STARVED_DECODE_FRACTION):
+            return min(current + 1, self.max_workers)
+        if decode_fraction <= IDLE_DECODE_FRACTION:
+            return max(current - 1, self.min_workers)
+        return current

@@ -1,0 +1,531 @@
+"""The always-on extraction daemon: ingest queue → tenant scheduler → packer.
+
+Turns the batch pipeline into a serving loop (ROADMAP item 1): one
+:class:`..extractors.base.PackedSession` lives for the daemon's lifetime, so
+the corpus packer's slot queues stay warm ACROSS requests — the tail batch
+of tenant A's request packs with the head of tenant B's — and the mesh never
+drains while there is backlog. The Podracer split (PAPERS.md) is preserved:
+CPU-bound decode producers (the byte-capped ``DecodePrefetcher``) are the
+buffer that absorbs bursts, the device consumer runs one batch always in
+flight per bucket, and the scheduler in between decides *whose* video feeds
+the queues next (weighted-fair + deadline, :mod:`.scheduler`).
+
+Lifecycle:
+
+- **drain** (SIGTERM / SIGINT / ``{"op": "drain"}``): stop admitting, finish
+  every admitted video, pad-flush the partial queues, resolve all writes,
+  write every request's result record, exit 0/1.
+- **reload** (SIGHUP / ``{"op": "reload"}``): re-read ``tenants.json`` from
+  the spool directory (weights/quotas) and close all tenant breakers.
+- a second SIGTERM/SIGINT aborts immediately (KeyboardInterrupt semantics;
+  the write-before-done and atomic-write invariants still hold on unwind).
+
+Failure semantics: a video failure is attempted once per schedule; transient
+classes re-enter the queue (same admission seq — retries do not go to the
+back of the line) until ``--retries`` is spent, then fail terminally into
+the shared failure manifest AND the owning request's result record. Terminal
+failures count against the tenant's breaker (``--tenant_max_failures``):
+tripping fails that tenant's queued videos fast and rejects its new
+submissions until a reload, while other tenants keep completing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..extractors.base import PackedSession
+from ..io.output import (
+    load_done_set,
+    request_result_path,
+    write_request_result,
+)
+from ..reliability import (
+    TenantBreaker,
+    TenantBreakerOpen,
+    classify,
+    record_failure,
+)
+from ..utils.metrics import StageClock
+from .autoscale import DecodeAutoscaler
+from .ingest import SPOOL_TENANTS_FILE, SocketAPI, SpoolWatcher
+from .request import RequestRejected, ServiceRequest, parse_request
+from .scheduler import RequestQueue
+
+
+class ExtractionService:
+    """One extractor serving a live request stream until drained."""
+
+    def __init__(self, extractor, poll_interval: float = 0.05):
+        cfg = extractor.cfg
+        self.ex = extractor
+        self.cfg = cfg
+        spec = extractor.pack_spec()
+        if spec is None:
+            raise ValueError(
+                f"--serve needs a packing path, but {cfg.feature_type} has "
+                "none under this config (--show_pred and the single-clip "
+                "frame-sharded flow sandwich are batch-only)")
+        self.spec = spec
+        self._poll = poll_interval
+        # the service clock runs for the daemon's lifetime: decode/device
+        # attribution feeds the autoscaler and the stats op regardless of
+        # VFT_METRICS
+        extractor.clock = StageClock()
+        extractor._open_run_resources()
+        self.session = PackedSession(
+            extractor, spec, on_done=self._video_done,
+            on_failed=self._video_failed, forget_completed=True)
+        self.packer = self.session.packer
+        self.queue = RequestQueue(default_quota=cfg.tenant_quota)
+        self.breaker = TenantBreaker(cfg.tenant_max_failures)
+        self.notify_dir = cfg.notify_dir or os.path.join(
+            cfg.spool_dir or cfg.output_path, "results")
+        self._autoscaler = (DecodeAutoscaler()
+                            if cfg.decode_workers == 0 else None)
+        self._as_snapshot = (time.perf_counter(), 0.0, 0, 0)
+        self._done_set = (load_done_set(extractor.output_dir)
+                          if cfg.resume else set())
+        self._lock = threading.RLock()
+        self._requests: Dict[str, ServiceRequest] = {}
+        self._jobs: Dict[str, object] = {}  # abspath -> in-flight VideoJob
+        self._draining = threading.Event()
+        self._hup = threading.Event()
+        self._idle_since: Optional[float] = None
+        self._completed_requests = 0
+        self._closed = False
+        if cfg.spool_dir:
+            self._load_tenants_config(initial=True)
+
+    # --- submission (ingest threads + tests call these) ----------------------
+
+    def submit(self, payload, request_id: Optional[str] = None,
+               source: str = "api") -> ServiceRequest:
+        """Admit one request end to end; raises :class:`RequestRejected`."""
+        if self._draining.is_set():
+            raise RequestRejected("service is draining; resubmit after "
+                                  "restart")
+        request = parse_request(payload, request_id=request_id, source=source)
+        with self._lock:
+            if request.request_id in self._requests:
+                raise RequestRejected(
+                    f"request_id {request.request_id!r} is already live")
+            if self.breaker.tripped(request.tenant):
+                raise RequestRejected(
+                    f"tenant {request.tenant!r} breaker is open "
+                    f"({self.breaker.failures(request.tenant)} terminal "
+                    "failures); fix the inputs and SIGHUP-reload")
+            to_queue = request.videos
+            resumed = ()
+            if self._done_set:
+                resumed = tuple(v for v in request.videos
+                                if os.path.abspath(v) in self._done_set)
+                to_queue = tuple(v for v in request.videos
+                                 if os.path.abspath(v) not in self._done_set)
+            if to_queue:
+                self.queue.submit(request, videos=to_queue)
+            self._requests[request.request_id] = request
+            for v in resumed:
+                request.done.append(os.path.abspath(v))
+            print(f"[serve] accepted {request.request_id} "
+                  f"(tenant={request.tenant}, {len(to_queue)} queued"
+                  + (f", {len(resumed)} resumed" if resumed else "") + ")")
+            self._maybe_finish_request(request)
+        return request
+
+    def reject(self, request_id: str, reason: str, source: str = "api",
+               payload=None) -> None:
+        """Record a rejected submission where the submitter will look."""
+        tenant = (payload or {}).get("tenant") if isinstance(payload, dict) \
+            else None
+        print(f"[serve] rejected {request_id}: {reason}")
+        try:
+            write_request_result(self.notify_dir, request_id, {
+                "request_id": request_id,
+                "tenant": tenant if isinstance(tenant, str) else None,
+                "state": "rejected",
+                "reason": reason,
+                "source": source,
+                "completed_at": time.time(),
+            })
+        except Exception as e:  # noqa: BLE001 — fault-barrier: a rejection record is best-effort; the daemon must outlive a full notify disk
+            print(f"[serve] could not record rejection {request_id}: {e}",
+                  file=sys.stderr)
+
+    # --- the serving loop (daemon thread only) -------------------------------
+
+    def step(self) -> bool:
+        """One scheduling step; True when it did video work."""
+        if self._hup.is_set():
+            self._hup.clear()
+            self.reload()
+        job = self.queue.next_job()
+        if job is None:
+            # resolve outstanding writes so finished videos complete their
+            # requests even while no new work arrives
+            self.session.emit_completed(reap_limit=0)
+            if self.packer.has_pending():
+                now = time.perf_counter()
+                if self._idle_since is None:
+                    self._idle_since = now
+                if (self._draining.is_set()
+                        or now - self._idle_since >= self.cfg.idle_flush_sec):
+                    # nothing left to pack with: latency beats occupancy —
+                    # pad-flush the partial queues so in-flight requests
+                    # complete now instead of at the next burst
+                    self.session.drain(final=False)
+                    self._idle_since = None
+            return False
+        self._idle_since = None
+        path = job.path
+        tenant = job.request.tenant
+        if self.breaker.tripped(tenant):
+            # raced a trip while queued (requeue after drain_tenant)
+            self._fail_job_fast(job, "breaker opened while queued")
+            return True
+        with self._lock:
+            self._jobs[path] = job
+        pool = self.ex._decode_pool
+        if pool is not None:
+            pool.schedule(path)
+            for p in self.queue.peek_paths(max(pool.workers - 1, 0)):
+                pool.schedule(p)
+        try:
+            self.session.ingest(path, retries=0)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point (serving loop)
+            # one schedule = one attempt; _video_failed (the session's
+            # on_failed hook) owns the requeue-vs-terminal decision so this
+            # path, failed writes, and co-packed batch victims all share one
+            # retry budget
+            self.session.fail(path, e)
+        finally:
+            if pool is not None:
+                pool.release(path)
+        self.session.emit_completed(reap_limit=1)
+        return True
+
+    def run(self) -> int:
+        """Serve until drained; returns 0 (no terminal failures) or 1."""
+        try:
+            while True:
+                did = self.step()
+                if self._draining.is_set() and self._quiescent():
+                    # everything admitted has been ingested; pad-flush what
+                    # still sits in the queues and resolve every write. A
+                    # failed flush/write may REQUEUE its transient victims —
+                    # quiescent again only once they resolved too
+                    self.session.drain(final=True)
+                    if self._quiescent():
+                        break
+                if not did:
+                    time.sleep(self._poll)
+            with self._lock:
+                for request in list(self._requests.values()):
+                    self._maybe_finish_request(request, force=True)
+        finally:
+            self.close()
+        return 0 if self.ex._failures == 0 else 1
+
+    def request_drain(self) -> None:
+        if not self._draining.is_set():
+            print("[serve] drain requested: finishing admitted videos, then "
+                  "exiting")
+        self._draining.set()
+
+    def reload(self) -> None:
+        """SIGHUP: re-read tenants.json, close every tenant breaker."""
+        if self.cfg.spool_dir:
+            self._load_tenants_config()
+        self.breaker.reset()
+        print("[serve] reload: tenant config re-read, breakers closed")
+
+    def close(self) -> None:
+        """Tear down run resources (idempotent; run() calls it on exit)."""
+        if self._closed:
+            return
+        self._closed = True
+        self.ex._close_run_resources()
+        self.ex.clock = None
+
+    # --- bookkeeping (PackedSession callbacks; daemon thread) ----------------
+
+    def _video_done(self, path: str) -> None:
+        with self._lock:
+            job = self._jobs.pop(path, None)
+            if job is None:
+                return
+            job.request.done.append(path)
+            self._maybe_finish_request(job.request)
+
+    def _video_failed(self, path: str, exc: BaseException) -> bool:
+        """Claim a transient failure by re-enqueueing (returns True — the
+        shared terminal accounting is skipped), else record it terminally.
+
+        This is where a co-packed batch failure's VICTIMS land (a device
+        fault on one dispatched batch fails every co-resident video): they
+        are transient by classification, so they re-enter the scheduler
+        under the same retry budget as a directly-failing video — an
+        innocent tenant's video lost to a neighbour's poisoned batch must
+        not count against that tenant's breaker."""
+        with self._lock:
+            job = self._jobs.pop(path, None)
+            if job is None:
+                return False
+            request = job.request
+            err_class, transient = classify(exc)
+            job.attempts += 1
+            if (transient and job.attempts <= self.cfg.retries
+                    and not self.breaker.tripped(request.tenant)):
+                self.packer.discard(path)
+                print(f"[serve] [{err_class}] attempt {job.attempts} failed "
+                      f"for {path}: {exc}; re-enqueued "
+                      f"({self.cfg.retries + 1 - job.attempts} attempt(s) "
+                      "left)")
+                self.queue.requeue(job)
+                return True
+            try:
+                exc.attempts = job.attempts  # manifest records real count
+            except AttributeError:
+                pass
+            request.failed.append({
+                "video": path, "error_class": err_class,
+                "transient": transient, "message": str(exc)[:500],
+            })
+            self._maybe_finish_request(request)
+            if self.breaker.record_failure(request.tenant):
+                self._fail_fast_tenant(request.tenant)
+            return False
+
+    def _fail_fast_tenant(self, tenant: str) -> None:
+        """Breaker tripped: fail the tenant's queued videos without decoding."""
+        jobs = self.queue.drain_tenant(tenant)
+        print(f"[serve] tenant {tenant!r} breaker OPEN "
+              f"({self.breaker.failures(tenant)} terminal failures): "
+              f"failing {len(jobs)} queued video(s) fast; new submissions "
+              "rejected until reload")
+        for job in jobs:
+            self._fail_job_fast(job, "tenant breaker open")
+
+    def _fail_job_fast(self, job, why: str) -> None:
+        exc = TenantBreakerOpen(
+            f"{job.path}: {why} (tenant {job.request.tenant!r}); not "
+            "attempted")
+        try:
+            record_failure(self.ex.output_dir, job.path, exc)
+        except OSError as e:
+            print(f"warning: could not record failure for {job.path}: {e}",
+                  file=sys.stderr)
+        pool = self.ex._decode_pool
+        if pool is not None:
+            pool.release(job.path)  # may have been prefetch-scheduled
+        with self._lock:
+            job.request.failed.append({
+                "video": job.path, "error_class": "TenantBreakerOpen",
+                "transient": False, "message": str(exc)[:500],
+            })
+            self._maybe_finish_request(job.request)
+
+    def _maybe_finish_request(self, request: ServiceRequest,
+                              force: bool = False) -> None:
+        if not request.complete and not force:
+            return
+        record = request.result_record()
+        if force and not request.complete:
+            record["state"] = "aborted"  # drain unwound before completion
+        try:
+            write_request_result(self.notify_dir, request.request_id, record)
+        except Exception as e:  # noqa: BLE001 — fault-barrier: the notification is advisory; outputs + manifests already landed
+            print(f"[serve] could not write result for "
+                  f"{request.request_id}: {e}", file=sys.stderr)
+        self._requests.pop(request.request_id, None)
+        self._completed_requests += 1
+        print(f"[serve] request {request.request_id} {record['state']}: "
+              f"{len(request.done)} done, {len(request.failed)} failed")
+        self._autoscale_tick()
+
+    def _autoscale_tick(self) -> None:
+        """Between requests: act on the interval's decode-starvation signal."""
+        pool = self.ex._decode_pool
+        if self._autoscaler is None or pool is None:
+            return
+        now = time.perf_counter()
+        decode = self.ex.clock.seconds.get("decode", 0.0)
+        real, slots = self.packer.real_slots, self.packer.dispatched_slots
+        t0, d0, r0, s0 = self._as_snapshot
+        self._as_snapshot = (now, decode, real, slots)
+        d_slots = slots - s0
+        occupancy = (real - r0) / d_slots if d_slots else 1.0
+        new = self._autoscaler.decide(occupancy, decode - d0, now - t0,
+                                      pool.workers,
+                                      dispatched_slots=d_slots)
+        if new != pool.workers:
+            print(f"[serve] decode autoscale: {pool.workers} → {new} "
+                  f"worker(s) (interval occupancy {occupancy:.1%}, decode "
+                  f"{decode - d0:.2f}s of {now - t0:.2f}s)")
+            pool.resize(new)
+
+    def _quiescent(self) -> bool:
+        with self._lock:
+            return (self.queue.pending() == 0 and not self._jobs
+                    and not self.packer.has_pending()
+                    and not self.ex._pending_writes)
+
+    def _load_tenants_config(self, initial: bool = False) -> None:
+        path = os.path.join(self.cfg.spool_dir, SPOOL_TENANTS_FILE)
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                self.queue.configure(json.load(f))
+            print(f"[serve] tenant config loaded from {path}")
+        except (OSError, ValueError) as e:
+            msg = f"[serve] bad tenant config {path}: {e}"
+            if initial:
+                raise ValueError(msg) from e
+            print(msg + " — keeping the previous config", file=sys.stderr)
+
+    # --- socket API ----------------------------------------------------------
+
+    def status(self, request_id: str) -> dict:
+        with self._lock:
+            request = self._requests.get(request_id)
+            if request is not None:
+                return {"ok": True, "state": request.state,
+                        "tenant": request.tenant,
+                        "videos": len(request.videos),
+                        "done": len(request.done),
+                        "failed": len(request.failed)}
+        path = request_result_path(self.notify_dir, request_id)
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    record = json.load(f)
+                return {"ok": True, **record}
+            except (OSError, ValueError) as e:
+                return {"ok": False, "error": f"unreadable result: {e}"}
+        return {"ok": False, "error": f"unknown request_id {request_id!r}"}
+
+    def stats(self) -> dict:
+        pool = self.ex._decode_pool
+        with self._lock:
+            return {
+                "ok": True,
+                "feature_type": self.cfg.feature_type,
+                "draining": self._draining.is_set(),
+                "live_requests": len(self._requests),
+                "in_flight_videos": len(self._jobs),
+                "queued_videos": self.queue.pending(),
+                "completed_requests": self._completed_requests,
+                "videos_ok": self.ex._ok,
+                "videos_failed": self.ex._failures,
+                "packing": {
+                    "real_slots": self.packer.real_slots,
+                    "dispatched_slots": self.packer.dispatched_slots,
+                    "occupancy": round(self.packer.occupancy, 4),
+                },
+                "decode_workers": pool.workers if pool is not None else 0,
+                "tenants": self.queue.stats(),
+                "breaker_open": list(self.breaker.open_tenants()),
+            }
+
+    def handle_op(self, op: dict) -> dict:
+        """Dispatch one socket-API operation (transport in :mod:`.ingest`)."""
+        kind = op.get("op")
+        if kind == "ping":
+            return {"ok": True}
+        if kind == "submit":
+            try:
+                request = self.submit(op, request_id=op.get("request_id"),
+                                      source="socket")
+            except RequestRejected as e:
+                return {"ok": False, "error": str(e)}
+            return {"ok": True, "request_id": request.request_id,
+                    "state": request.state}
+        if kind == "status":
+            return self.status(str(op.get("request_id", "")))
+        if kind == "stats":
+            return self.stats()
+        if kind == "drain":
+            self.request_drain()
+            return {"ok": True, "draining": True}
+        if kind == "reload":
+            # applied by the daemon loop before its next pop (thread safety:
+            # reload mutates scheduler weights and breakers)
+            self._hup.set()
+            return {"ok": True, "reload": "scheduled"}
+        return {"ok": False, "error": f"unknown op {kind!r}"}
+
+
+def serve(cfg) -> int:
+    """Run the daemon for ``cfg`` (``--serve`` / ``python -m …serve``)."""
+    from ..extractors import get_extractor
+
+    if not cfg.spool_dir:
+        print("--serve requires --spool_dir (the watched request directory)",
+              file=sys.stderr)
+        return 2
+    os.makedirs(cfg.spool_dir, exist_ok=True)
+    extractor = get_extractor(cfg)
+    try:
+        service = ExtractionService(extractor)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    watcher = SpoolWatcher(cfg.spool_dir, service,
+                           poll_interval=cfg.spool_poll_sec)
+    sock_path = cfg.socket_path
+    if sock_path is None:
+        sock_path = os.path.join(cfg.spool_dir, "control.sock")
+    api = (SocketAPI(sock_path, service)
+           if sock_path and sock_path.lower() != "none" else None)
+
+    def on_term(signum, frame):
+        if service._draining.is_set():
+            raise KeyboardInterrupt  # second signal: abort now
+        service.request_drain()
+
+    def on_hup(signum, frame):
+        service._hup.set()
+
+    if threading.current_thread() is threading.main_thread():
+        signal.signal(signal.SIGTERM, on_term)
+        signal.signal(signal.SIGINT, on_term)
+        signal.signal(signal.SIGHUP, on_hup)
+    watcher.start()
+    if api is not None:
+        api.start()
+        print(f"[serve] socket API at {sock_path}")
+    print(f"[serve] watching {cfg.spool_dir} "
+          f"(results → {service.notify_dir}); SIGTERM drains, SIGHUP "
+          "reloads")
+    try:
+        return service.run()
+    finally:
+        watcher.stop()
+        if api is not None:
+            api.stop()
+
+
+def main(argv=None) -> int:
+    """``python -m video_features_tpu.serve`` — the batch CLI surface with
+    ``--serve`` implied."""
+    from ..cli import parse_args
+    from ..run import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+    cfg = parse_args(list(argv) if argv is not None else None)
+    if not cfg.serve:
+        cfg = cfg.replace(serve=True)
+        try:
+            cfg.validate()
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    return serve(cfg)

@@ -1,0 +1,209 @@
+"""Ingest transports for the extraction daemon: spool directory + local socket.
+
+Two ways work enters the service, both translating into
+:meth:`..serve.daemon.ExtractionService.submit`:
+
+- **spool directory** (:class:`SpoolWatcher`): a tenant drops
+  ``<request_id>.json`` into the watched directory (write-to-temp + rename —
+  the watcher treats every ``*.json`` as complete). Accepted files are
+  renamed ``<name>.json.accepted``; rejects rename to ``.rejected`` AND get
+  a ``rejected``-state result record in the notify directory, so a submitter
+  only ever polls one place. ``tenants.json`` is the scheduler's config
+  file, not a request — skipped by name.
+- **local socket** (:class:`SocketAPI`): newline-delimited JSON over a Unix
+  stream socket, one request per connection. Ops: ``submit``, ``status``,
+  ``stats``, ``drain``, ``reload``, ``ping``. The daemon's
+  ``handle_op(dict) -> dict`` does the work; this class is transport only.
+
+Both run one daemon thread each and publish exclusively through the
+service's locked methods — the threads themselves store nothing shared
+(vftlint ``thread-shared-state``: declared in THREAD_MODULES, no
+SHARED_WRITES entries needed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+from typing import Optional
+
+from .request import RequestRejected
+
+SPOOL_TENANTS_FILE = "tenants.json"
+
+
+class SpoolWatcher:
+    """Poll a spool directory for per-tenant request files."""
+
+    def __init__(self, spool_dir: str, service, poll_interval: float = 0.25):
+        self.spool_dir = spool_dir
+        self._service = service
+        self._poll = poll_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def scan_once(self) -> int:
+        """One pass over the spool; returns how many files were consumed.
+
+        Callable without the thread (tests, and the daemon's final pre-drain
+        sweep). Consumed = renamed away, accepted or not; a rename failure
+        leaves the file for the next pass.
+        """
+        try:
+            names = sorted(os.listdir(self.spool_dir))
+        except OSError as e:
+            print(f"[serve] cannot list spool {self.spool_dir}: {e}",
+                  file=sys.stderr)
+            return 0
+        consumed = 0
+        for name in names:
+            if not name.endswith(".json") or name == SPOOL_TENANTS_FILE:
+                continue
+            path = os.path.join(self.spool_dir, name)
+            request_id = name[: -len(".json")]
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError) as e:
+                consumed += self._finish(path, ".rejected")
+                self._service.reject(request_id, f"unreadable request file: "
+                                     f"{e}", source="spool")
+                continue
+            # claim BEFORE submitting: if the rename fails the file simply
+            # waits for the next pass un-submitted — renaming after a
+            # successful submit could re-submit (and eventually re-extract)
+            # the whole request when the rename fails
+            if not self._finish(path, ".accepted"):
+                continue
+            consumed += 1
+            try:
+                self._service.submit(payload, request_id=request_id,
+                                     source="spool")
+            except RequestRejected as e:
+                self._rename(path + ".accepted", path + ".rejected")
+                self._service.reject(request_id, str(e), source="spool",
+                                     payload=payload)
+        return consumed
+
+    @staticmethod
+    def _finish(path: str, suffix: str) -> int:
+        return SpoolWatcher._rename(path, path + suffix)
+
+    @staticmethod
+    def _rename(src: str, dst: str) -> int:
+        try:
+            os.replace(src, dst)
+            return 1
+        except OSError as e:
+            print(f"[serve] cannot rename {src}: {e}", file=sys.stderr)
+            return 0
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="spool-watcher")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.scan_once()
+            self._stop.wait(self._poll)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class SocketAPI:
+    """Line-JSON submit/status API on a Unix stream socket."""
+
+    def __init__(self, socket_path: str, service):
+        self.socket_path = socket_path
+        self._service = service
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._srv: Optional[socket.socket] = None
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)  # stale socket from a previous run
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(self.socket_path)
+        srv.listen(8)
+        srv.settimeout(0.2)  # keeps the accept loop stop-responsive
+        self._srv = srv
+        self._thread = threading.Thread(target=self._serve, daemon=True,
+                                        name="socket-api")
+        self._thread.start()
+
+    def _serve(self) -> None:
+        srv = self._srv
+        while not self._stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # socket closed under us at stop()
+            try:
+                conn.settimeout(2.0)
+                self._handle(conn)
+            except Exception as e:  # noqa: BLE001 — fault-barrier: one bad client connection must not kill the API thread
+                print(f"[serve] socket client error: {e}", file=sys.stderr)
+            finally:
+                conn.close()
+
+    def _handle(self, conn: socket.socket) -> None:
+        buf = b""
+        while b"\n" not in buf and len(buf) < 1 << 20:
+            chunk = conn.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+        line = buf.split(b"\n", 1)[0].strip()
+        if not line:
+            return
+        try:
+            op = json.loads(line.decode("utf-8"))
+            if not isinstance(op, dict):
+                raise ValueError("request must be a JSON object")
+        except ValueError as e:
+            response = {"ok": False, "error": f"bad request: {e}"}
+        else:
+            response = self._service.handle_op(op)
+        conn.sendall(json.dumps(response).encode("utf-8") + b"\n")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            self._srv = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+
+def socket_request(socket_path: str, op: dict, timeout: float = 5.0) -> dict:
+    """One client round-trip (tools/tests; also the cheapest CLI client)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(timeout)
+        s.connect(socket_path)
+        s.sendall(json.dumps(op).encode("utf-8") + b"\n")
+        buf = b""
+        while b"\n" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.split(b"\n", 1)[0].decode("utf-8"))

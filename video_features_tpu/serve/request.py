@@ -1,0 +1,139 @@
+"""Service requests: what a tenant submits and how its state is tracked.
+
+One request = one tenant asking for features over a list of videos, with an
+optional deadline. Requests arrive as JSON — a file dropped into the spool
+directory (the file stem becomes the request id) or a line over the local
+socket API (:mod:`.ingest`) — and resolve into a single per-request result
+record (:func:`..io.output.write_request_result`) once every video reached a
+terminal state.
+
+Schema (all extra keys ignored)::
+
+    {
+      "tenant": "alice",               # optional; "default" when omitted
+      "videos": ["/abs/a.mp4", ...],   # required, non-empty list of paths
+      "deadline": 1767200000.0,        # optional absolute epoch seconds
+      "deadline_sec": 30.0,            # optional relative; wins over nothing
+      "request_id": "batch-7"          # optional (socket); spool uses the
+    }                                  # file stem and ignores this key
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+
+class RequestRejected(ValueError):
+    """Admission control said no (malformed request, quota, open breaker).
+
+    A rejection is terminal and cheap by design: the submitter gets the
+    reason synchronously (socket) or in a ``.result.json`` with state
+    ``rejected`` (spool) — nothing was queued.
+    """
+
+
+class VideoJob:
+    """One schedulable unit: a video owned by a request.
+
+    ``attempts`` counts terminal-attempt failures so transient errors can
+    re-enter the queue (:meth:`..serve.scheduler.RequestQueue.requeue`)
+    instead of sleeping a backoff inside the serving loop; ``seq`` is the
+    queue's global admission counter (FIFO tiebreak within a tenant).
+    """
+
+    __slots__ = ("path", "request", "seq", "attempts")
+
+    def __init__(self, path: str, request: "ServiceRequest", seq: int = 0):
+        self.path = path
+        self.request = request
+        self.seq = seq
+        self.attempts = 0
+
+    @property
+    def deadline(self) -> Optional[float]:
+        return self.request.deadline
+
+    def sort_key(self) -> Tuple[float, int]:
+        """(deadline or +inf, admission order) — EDF within a tenant."""
+        d = self.request.deadline
+        return (d if d is not None else float("inf"), self.seq)
+
+
+class ServiceRequest:
+    """Parsed, admitted request plus its live completion state."""
+
+    def __init__(self, request_id: str, tenant: str, videos: Tuple[str, ...],
+                 deadline: Optional[float] = None, source: str = "api"):
+        self.request_id = request_id
+        self.tenant = tenant
+        self.videos = videos
+        self.deadline = deadline
+        self.source = source
+        self.submitted_at = time.time()
+        self.done: List[str] = []
+        self.failed: List[Dict] = []  # {video, error_class, transient, message}
+
+    @property
+    def complete(self) -> bool:
+        return len(self.done) + len(self.failed) >= len(self.videos)
+
+    @property
+    def state(self) -> str:
+        if not self.complete:
+            return "pending"
+        return "done" if not self.failed else (
+            "failed" if not self.done else "partial")
+
+    def result_record(self) -> Dict:
+        """The per-request done/failed manifest written at completion."""
+        return {
+            "request_id": self.request_id,
+            "tenant": self.tenant,
+            "state": self.state,
+            "videos": len(self.videos),
+            "done": sorted(self.done),
+            "failed": sorted(self.failed, key=lambda r: r["video"]),
+            "deadline": self.deadline,
+            "submitted_at": self.submitted_at,
+            "completed_at": time.time(),
+            "source": self.source,
+        }
+
+
+def parse_request(payload, request_id: Optional[str] = None,
+                  source: str = "api") -> ServiceRequest:
+    """Validate a submitted JSON object into a :class:`ServiceRequest`.
+
+    Raises :class:`RequestRejected` with an operator-readable reason on any
+    schema violation — the ingest layer turns that into a rejection record,
+    never a daemon crash.
+    """
+    if not isinstance(payload, dict):
+        raise RequestRejected(f"request must be a JSON object, got "
+                              f"{type(payload).__name__}")
+    tenant = payload.get("tenant", "default")
+    if not isinstance(tenant, str) or not tenant:
+        raise RequestRejected("'tenant' must be a non-empty string")
+    videos = payload.get("videos")
+    if (not isinstance(videos, (list, tuple)) or not videos
+            or not all(isinstance(v, str) and v for v in videos)):
+        raise RequestRejected("'videos' must be a non-empty list of paths")
+    if len(set(videos)) != len(videos):
+        raise RequestRejected("'videos' contains duplicate paths (outputs "
+                              "are keyed by video path)")
+    deadline = payload.get("deadline")
+    if deadline is None and payload.get("deadline_sec") is not None:
+        rel = payload["deadline_sec"]
+        if not isinstance(rel, (int, float)) or rel <= 0:
+            raise RequestRejected("'deadline_sec' must be a positive number")
+        deadline = time.time() + float(rel)
+    elif deadline is not None and not isinstance(deadline, (int, float)):
+        raise RequestRejected("'deadline' must be epoch seconds")
+    rid = request_id or payload.get("request_id") or uuid.uuid4().hex[:12]
+    if not isinstance(rid, str) or not rid:
+        raise RequestRejected("'request_id' must be a non-empty string")
+    return ServiceRequest(rid, tenant, tuple(videos),
+                          deadline=float(deadline) if deadline is not None
+                          else None, source=source)

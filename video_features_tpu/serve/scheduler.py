@@ -1,0 +1,233 @@
+"""Admission control and per-tenant scheduling for the extraction daemon.
+
+The scheduling model follows the packer's own (Ragged Paged Attention,
+PAPERS.md): variable-length work units — videos of arbitrary clip counts —
+feed fixed-shape device batches, one batch always in flight. This module
+decides *whose* video feeds the packer's bucket queues next:
+
+- **admission**: each tenant has a pending-video quota; a request that would
+  exceed it is rejected at submit time (cheap, synchronous) instead of
+  ballooning the queue. Duplicate in-flight paths are rejected too — every
+  downstream structure (assemblies, manifests, the decode pool) is keyed by
+  video path.
+- **deadline first**: a request may carry a deadline (epoch seconds); among
+  tenants whose head video has one, the earliest deadline wins outright
+  (EDF). Within a tenant, videos order by (deadline, admission order).
+- **weighted fair** otherwise: stride scheduling over tenant virtual time —
+  popping a video advances its tenant's clock by ``1/weight``, and the
+  lowest clock goes next, so a tenant with weight 2 gets two videos per
+  competitor's one under contention while an uncontended queue runs at full
+  speed. A tenant waking from idle is clamped to the scheduler's clock
+  (no hoarding credit while idle).
+
+Thread-safe: ingest threads (:mod:`.ingest`) submit while the daemon's loop
+pops; one lock covers all state.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Dict, List, Optional
+
+from .request import RequestRejected, ServiceRequest, VideoJob
+
+DEFAULT_QUOTA = 64
+DEFAULT_WEIGHT = 1.0
+
+
+class _Tenant:
+    __slots__ = ("name", "weight", "quota", "vtime", "heap")
+
+    def __init__(self, name: str, weight: float, quota: int):
+        self.name = name
+        self.weight = weight
+        self.quota = quota
+        self.vtime = 0.0
+        # (deadline or +inf, seq, job): EDF then FIFO within the tenant
+        self.heap: List[tuple] = []
+
+
+class RequestQueue:
+    """Tenant-aware pending-video queue with quotas and fair ordering."""
+
+    def __init__(self, default_weight: float = DEFAULT_WEIGHT,
+                 default_quota: int = DEFAULT_QUOTA,
+                 tenants: Optional[dict] = None):
+        self._lock = threading.Lock()
+        self._default_weight = default_weight
+        self._default_quota = default_quota
+        self._overrides: Dict[str, dict] = {}
+        self._tenants: Dict[str, _Tenant] = {}
+        self._queued_paths: set = set()
+        self._vclock = 0.0
+        self._seq = 0
+        if tenants:
+            self.configure(tenants)
+
+    # --- configuration (start + SIGHUP reload) -------------------------------
+
+    def configure(self, tenants_cfg: dict) -> None:
+        """Apply a ``tenants.json``-shaped config::
+
+            {"default": {"weight": 1, "quota": 64},
+             "tenants": {"alice": {"weight": 2, "quota": 256}}}
+
+        Existing queues keep their entries; weights/quotas take effect on
+        the next pop/submit. Unknown keys are ignored (forward compat).
+        """
+        if not isinstance(tenants_cfg, dict):
+            raise ValueError("tenant config must be a JSON object")
+        default = tenants_cfg.get("default") or {}
+        overrides = dict(tenants_cfg.get("tenants") or {})
+        # parse + validate EVERYTHING before mutating: a bad tenants.json at
+        # SIGHUP must leave the previous config fully intact (the daemon
+        # catches ValueError and keeps serving), never a half-applied one —
+        # TypeError from a null/str value must not escape the catch either
+        try:
+            new_weight = float(default.get("weight", self._default_weight))
+            new_quota = int(default.get("quota", self._default_quota))
+            parsed = {
+                name: (float((ov or {}).get("weight", new_weight)),
+                       int((ov or {}).get("quota", new_quota)))
+                for name, ov in overrides.items()
+            }
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"tenant config has a non-numeric "
+                             f"weight/quota: {e}") from e
+        for name, (weight, quota) in [("default", (new_weight, new_quota)),
+                                      *parsed.items()]:
+            if weight <= 0:
+                raise ValueError(f"tenant {name!r}: weight must be > 0")
+            if quota < 1:
+                raise ValueError(f"tenant {name!r}: quota must be >= 1")
+        with self._lock:
+            self._default_weight = new_weight
+            self._default_quota = new_quota
+            self._overrides = overrides
+            for name, t in self._tenants.items():
+                t.weight, t.quota = parsed.get(name, (new_weight, new_quota))
+
+    def _tenant(self, name: str) -> _Tenant:
+        t = self._tenants.get(name)
+        if t is None:
+            ov = self._overrides.get(name) or {}
+            weight = float(ov.get("weight", self._default_weight))
+            quota = int(ov.get("quota", self._default_quota))
+            if weight <= 0:
+                raise ValueError(f"tenant {name!r}: weight must be > 0")
+            t = self._tenants[name] = _Tenant(name, weight, quota)
+        return t
+
+    # --- submission ----------------------------------------------------------
+
+    def submit(self, request: ServiceRequest, videos=None) -> List[VideoJob]:
+        """Admit every video of ``request`` or none; returns the jobs queued.
+
+        ``videos``: the subset to actually queue (the daemon strips
+        ``--resume``-done paths); defaults to all of the request's videos.
+        Raises :class:`RequestRejected` over quota or on a path already
+        pending/in flight.
+        """
+        import os
+
+        if videos is None:
+            videos = request.videos
+        with self._lock:
+            t = self._tenant(request.tenant)
+            if self._pending_locked(t) + len(videos) > t.quota:
+                raise RequestRejected(
+                    f"tenant {request.tenant!r} over quota: "
+                    f"{self._pending_locked(t)} pending + "
+                    f"{len(videos)} submitted > {t.quota} "
+                    "(raise it in tenants.json and SIGHUP-reload)")
+            paths = [os.path.abspath(p) for p in videos]
+            dup = [p for p in paths if p in self._queued_paths]
+            if dup:
+                raise RequestRejected(
+                    f"video(s) already queued by a live request: "
+                    f"{', '.join(sorted(dup)[:3])}"
+                    + ("…" if len(dup) > 3 else ""))
+            was_idle = not t.heap
+            jobs = []
+            for path in paths:
+                self._seq += 1
+                job = VideoJob(path, request, seq=self._seq)
+                heapq.heappush(t.heap, (*job.sort_key(), job))
+                self._queued_paths.add(path)
+                jobs.append(job)
+            if was_idle:
+                # waking tenant joins at the scheduler clock: idle time is
+                # not banked credit against active tenants
+                t.vtime = max(t.vtime, self._vclock)
+            return jobs
+
+    def requeue(self, job: VideoJob) -> None:
+        """Re-admit a transiently-failed video (retry budget handled by the
+        daemon). Keeps its original admission seq, so it schedules ahead of
+        later submissions — a retry should not go to the back of the line."""
+        with self._lock:
+            t = self._tenant(job.request.tenant)
+            was_idle = not t.heap
+            heapq.heappush(t.heap, (*job.sort_key(), job))
+            self._queued_paths.add(job.path)
+            if was_idle:
+                t.vtime = max(t.vtime, self._vclock)
+
+    # --- scheduling ----------------------------------------------------------
+
+    def next_job(self) -> Optional[VideoJob]:
+        """Pop the next video: earliest head deadline wins across tenants,
+        then lowest weighted virtual time, then name (determinism)."""
+        with self._lock:
+            active = [t for t in self._tenants.values() if t.heap]
+            if not active:
+                return None
+            t = min(active, key=lambda t: (t.heap[0][0], t.vtime, t.name))
+            _, _, job = heapq.heappop(t.heap)
+            self._queued_paths.discard(job.path)
+            self._vclock = t.vtime
+            t.vtime += 1.0 / t.weight
+            return job
+
+    def peek_paths(self, n: int) -> List[str]:
+        """Up to ``n`` likely-next paths (decode-prefetch hints; approximate
+        order is fine — the pool buffers whatever is scheduled early)."""
+        with self._lock:
+            entries = heapq.nsmallest(
+                n, (e for t in self._tenants.values() for e in t.heap))
+            return [e[2].path for e in entries]
+
+    def drain_tenant(self, tenant: str) -> List[VideoJob]:
+        """Remove and return every queued job of ``tenant`` (breaker trip)."""
+        with self._lock:
+            t = self._tenants.get(tenant)
+            if t is None:
+                return []
+            jobs = [e[2] for e in sorted(t.heap)]
+            t.heap.clear()
+            for job in jobs:
+                self._queued_paths.discard(job.path)
+            return jobs
+
+    # --- introspection -------------------------------------------------------
+
+    @staticmethod
+    def _pending_locked(t: _Tenant) -> int:
+        return len(t.heap)
+
+    def pending(self, tenant: Optional[str] = None) -> int:
+        with self._lock:
+            if tenant is not None:
+                t = self._tenants.get(tenant)
+                return len(t.heap) if t else 0
+            return sum(len(t.heap) for t in self._tenants.values())
+
+    def stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                t.name: {"pending": len(t.heap), "weight": t.weight,
+                         "quota": t.quota, "vtime": round(t.vtime, 3)}
+                for t in sorted(self._tenants.values(), key=lambda t: t.name)
+                if t.heap
+            }
