@@ -1019,6 +1019,81 @@ def main() -> None:
                  f"occupancy {entry['packing_occupancy']} (one-batch-run "
                  f"baseline {entry['batch_occupancy_baseline']})")
 
+    # ---- content-addressed feature cache (--cache_dir) ------------------------
+    # Duplicate-heavy corpus (each unique video uploaded `dups` times, the
+    # "millions of users" traffic shape): a cold pass measures in-run dedup
+    # (later copies of a video hit the entry its first copy published) and a
+    # warm pass over the same cache measures the steady state — hit rate and
+    # wall-clock speedup vs the cold pass, zero device steps on hits
+    # (docs/caching.md). Stale-record protocol unchanged: rides guarded()/
+    # clear_failure like every scenario; the headline is untouched.
+    if not over_budget("cache_hit_rate"):
+        with guarded("cache_hit_rate"):
+            n_unique = 2 if on_cpu else 6
+            dups = 3 if on_cpu else 4
+            unique = write_corpus(
+                "cache_corpus",
+                [((64, 48), 4 + i if on_cpu else 8 + i)
+                 for i in range(n_unique)])
+            corpus = list(unique)
+            for src in unique:
+                for j in range(dups - 1):
+                    dst = src.replace(".mp4", f"_dup{j}.mp4")
+                    shutil.copyfile(src, dst)
+                    corpus.append(dst)
+            cache_dir = os.path.join("/tmp/vft_bench", "feature_cache")
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+            def cache_cfg(sub):
+                return ExtractionConfig(
+                    feature_type="resnet50", batch_size=4 if on_cpu else 64,
+                    on_extraction="save_numpy", cache_dir=cache_dir,
+                    output_path=os.path.join("/tmp/vft_bench", sub),
+                    tmp_path=os.path.join("/tmp/vft_bench", "tmp"))
+
+            ex_cold = ExtractResNet50(cache_cfg("cache_cold"))
+            # compile the one jit signature outside the timed passes
+            _force(ex_cold._step(ex_cold.params, ex_cold.runner.put(
+                rng.integers(0, 256, (ex_cold.batch_size, 224, 224, 3),
+                             dtype=np.uint8))))
+            shutil.rmtree(ex_cold.output_dir, ignore_errors=True)
+            _log(f"cache_hit_rate: {len(corpus)} videos "
+                 f"({n_unique} unique × {dups} uploads), cold pass")
+            t0 = time.perf_counter()
+            ok = ex_cold.run(corpus)
+            cold_wall = time.perf_counter() - t0
+            if ok != len(corpus):
+                raise RuntimeError(f"cold pass extracted {ok}/{len(corpus)}")
+            cold_stats = ex_cold._cache.stats()
+
+            ex_warm = ExtractResNet50(cache_cfg("cache_warm"))
+            shutil.rmtree(ex_warm.output_dir, ignore_errors=True)
+            t0 = time.perf_counter()
+            ok = ex_warm.run(corpus)
+            warm_wall = time.perf_counter() - t0
+            if ok != len(corpus):
+                raise RuntimeError(f"warm pass extracted {ok}/{len(corpus)}")
+            warm_stats = ex_warm._cache.stats()
+            entry = {
+                "videos": len(corpus),
+                "unique_videos": n_unique,
+                "cold_wall_sec": round(cold_wall, 3),
+                "warm_wall_sec": round(warm_wall, 3),
+                "warm_speedup": round(cold_wall / warm_wall, 2),
+                "cold_hit_rate": cold_stats["hit_rate"],  # in-run dedup
+                "warm_hit_rate": warm_stats["hit_rate"],  # steady state: 1.0
+                "cache_entries": warm_stats["entries"],
+                "cache_bytes": warm_stats["total_bytes"],
+                "unit": "videos",
+                "code_rev": code_rev,
+            }
+            details["cache_hit_rate"] = entry
+            clear_failure("cache_hit_rate")
+            flush_details()
+            _log(f"cache_hit_rate: cold {entry['cold_hit_rate']:.0%} hits in "
+                 f"{cold_wall:.2f}s, warm {entry['warm_hit_rate']:.0%} in "
+                 f"{warm_wall:.2f}s ({entry['warm_speedup']}x speedup)")
+
     # ---- end-to-end extract(): decode → transform → device → collect ----------
     # The reference's real workload is whole videos through the full pipeline
     # (SURVEY §3.1 hot loop); device-step benches above exclude decode. Stage

@@ -62,6 +62,7 @@ def rng():
 # default `not slow` tier.
 _FAST_MODULES = {
     "test_async_writer",
+    "test_cache",
     "test_config_cli",
     "test_edge_cases",
     "test_fault_barrier_lint",

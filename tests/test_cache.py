@@ -1,0 +1,437 @@
+"""Content-addressed feature cache (--cache_dir, docs/caching.md): key
+stability and fingerprint pinning (every config flag owns a keying decision),
+CAS store round-trips / corrupt-entry quarantine / LRU eviction, cache-hit
+semantics in both run loops (byte parity, ZERO device dispatches, done-
+manifest entries so --resume composes), and the serving daemon's in-flight
+coalescing (N identical submissions → one extraction, waiter requeue on
+leader failure) — through the same lightweight jitted extractor as
+tests/test_packer.py."""
+
+import dataclasses
+import glob
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from test_packer import ToyPacked, _write_video
+
+from video_features_tpu.cache import (
+    EXECUTION_FIELDS,
+    FINGERPRINT_FIELDS,
+    FeatureCache,
+    InflightCoalescer,
+    cache_key,
+    config_fingerprint,
+    file_digest,
+    fingerprint_digest,
+)
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.io.output import load_done_set
+from video_features_tpu.reliability import load_failures, reset_faults
+from video_features_tpu.serve import ExtractionService
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """Four decodable tiny videos of mixed lengths (3, 5, 9, 2 frames)."""
+    d = tmp_path_factory.mktemp("cache_corpus")
+    return [_write_video(d / f"vid{i}.mp4", n)
+            for i, n in enumerate((3, 5, 9, 2))]
+
+
+def _cfg(tmp_path, sub, **kw):
+    kw.setdefault("retries", 1)
+    kw.setdefault("retry_backoff", 0.01)
+    kw.setdefault("cache_dir", str(tmp_path / "cache"))
+    return ExtractionConfig(
+        feature_type="resnet50", on_extraction="save_numpy", num_devices=1,
+        output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"), **kw)
+
+
+def _outputs(tmp_path, sub):
+    return {os.path.basename(p): np.load(p)
+            for p in glob.glob(str(tmp_path / sub / "resnet50" / "*.npy"))}
+
+
+def _assert_bytes_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k].dtype == b[k].dtype and a[k].shape == b[k].shape, k
+        assert a[k].tobytes() == b[k].tobytes(), k
+
+
+class Counting(ToyPacked):
+    """ToyPacked with a jit-dispatch counter: every device-step invocation
+    (per-video loop and packed loop share self._step) increments it, so
+    'a cache hit costs zero device steps' is a checkable number."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.dispatches = 0
+        inner = self._step
+
+        def counted(params, frames):
+            self.dispatches += 1
+            return inner(params, frames)
+
+        self._step = counted
+
+
+# ---- keying: every flag owns a decision ------------------------------------
+
+
+def test_every_config_field_has_a_keying_decision():
+    """THE PIN: each ExtractionConfig field appears in exactly one of
+    FINGERPRINT_FIELDS (feeds the cache key) or EXECUTION_FIELDS (declared
+    numerics-neutral). Adding a flag without classifying it fails here —
+    that is the point: an unclassified flag could silently serve features
+    computed under different numerics."""
+    fields = {f.name for f in dataclasses.fields(ExtractionConfig)}
+    fp, ex = set(FINGERPRINT_FIELDS), set(EXECUTION_FIELDS)
+    assert not fp & ex, f"fields classified twice: {sorted(fp & ex)}"
+    assert fp | ex == fields, (
+        f"unclassified: {sorted(fields - (fp | ex))}; "
+        f"stale: {sorted((fp | ex) - fields)} — decide in cache/key.py")
+
+
+def test_fingerprint_tracks_numeric_fields_and_ignores_execution_fields():
+    base = ExtractionConfig(feature_type="resnet50")
+    assert fingerprint_digest(base) == fingerprint_digest(base)  # stable
+    assert (fingerprint_digest(base.replace(dtype="bfloat16"))
+            != fingerprint_digest(base))
+    assert (fingerprint_digest(base.replace(extraction_fps=5))
+            != fingerprint_digest(base))
+    # execution knobs reshuffle HOW, not WHAT: same key, cache still hits
+    same = base.replace(batch_size=32, output_path="./elsewhere",
+                        decode_workers=4, retries=7, async_writer=False)
+    assert fingerprint_digest(same) == fingerprint_digest(base)
+
+
+def test_flow_padding_knobs_collapse_for_non_flow_configs():
+    """pack_corpus/pack_buckets/shape_bucket perturb numerics only where a
+    flow net sees replicate-padded frames; RGB/audio parity is pinned
+    byte-identical, so their fingerprints must SHARE entries across the
+    packed and per-video loops."""
+    rgb = ExtractionConfig(feature_type="resnet50")
+    assert (fingerprint_digest(rgb.replace(pack_corpus=True, pack_buckets=2))
+            == fingerprint_digest(rgb))
+    flow = ExtractionConfig(feature_type="raft")
+    assert (fingerprint_digest(flow.replace(pack_corpus=True))
+            != fingerprint_digest(flow))
+
+
+def test_default_i3d_resolves_like_explicit_two_stream():
+    """Keying decisions see RESOLVED configs: streams=None means BOTH i3d
+    streams, so (1) the raw and explicit spellings share one fingerprint,
+    (2) the flow-padding knobs count (a merged-bucket packed run must not
+    share entries with an unpacked one), and (3) the sandwich's flow-net
+    checkpoint is part of the weights version — swapping raft/pwc weights
+    invalidates default-i3d entries too."""
+    from video_features_tpu.cache import weights_fingerprint
+
+    raw = ExtractionConfig(feature_type="i3d")
+    explicit = raw.replace(streams=("rgb", "flow"), stack_size=64,
+                           step_size=64)
+    assert fingerprint_digest(raw) == fingerprint_digest(explicit)
+    assert (fingerprint_digest(raw.replace(pack_corpus=True))
+            != fingerprint_digest(raw))  # flow stream runs by default
+    assert "sintel" in weights_fingerprint(raw)  # pwc/raft checkpoint keyed
+    rgb_only = raw.replace(streams=("rgb",))
+    assert "sintel" not in weights_fingerprint(rgb_only)
+    assert fingerprint_digest(rgb_only) != fingerprint_digest(raw)
+
+
+def test_use_ffmpeg_resolves_to_unused_without_fps_resampling():
+    base = ExtractionConfig(feature_type="resnet50")
+    fp = config_fingerprint(base)
+    assert fp["use_ffmpeg"] == "unused"
+    assert (fingerprint_digest(base.replace(use_ffmpeg="never"))
+            == fingerprint_digest(base))
+
+
+def test_content_digest_is_content_addressed(tmp_path, corpus):
+    dup = str(tmp_path / "dup.mp4")
+    shutil.copyfile(corpus[0], dup)
+    assert file_digest(dup) == file_digest(corpus[0])  # path-independent
+    assert file_digest(corpus[0]) != file_digest(corpus[1])
+    key = cache_key(file_digest(corpus[0]), "fp")
+    assert key == cache_key(file_digest(dup), "fp")
+    assert key != cache_key(file_digest(corpus[0]), "fp2")
+
+
+def test_cache_max_bytes_requires_cache_dir(tmp_path):
+    with pytest.raises(ValueError, match="cache_max_bytes"):
+        _cfg(tmp_path, "v", cache_dir=None, cache_max_bytes=10).validate()
+    with pytest.raises(ValueError, match="cache_max_bytes"):
+        _cfg(tmp_path, "v", cache_max_bytes=0).validate()
+
+
+# ---- CAS store -------------------------------------------------------------
+
+
+def _entry_files(store):
+    return [p for p in glob.glob(os.path.join(store.cache_dir, "*", "*.npz"))
+            if os.path.dirname(p) != store.quarantine_dir]
+
+
+def test_store_round_trip_preserves_dtype_shape_bytes(tmp_path):
+    store = FeatureCache(str(tmp_path / "c"))
+    feats = {"feat": np.arange(12, dtype=np.float32).reshape(3, 4),
+             "timestamps_ms": np.array([0.0, 33.3, 66.6])}
+    assert store.put("k" * 64, feats)
+    got = store.get("k" * 64)
+    _assert_bytes_equal(got, feats)
+    assert store.get("m" * 64) is None  # miss
+    assert store.stats()["hits"] == 1 and store.stats()["misses"] == 1
+
+
+def test_store_survives_restart_and_skips_republish(tmp_path):
+    store = FeatureCache(str(tmp_path / "c"))
+    store.put("k" * 64, {"a": np.ones(3)})
+    again = FeatureCache(str(tmp_path / "c"))  # fresh process, same dir
+    assert again.stats()["entries"] == 1
+    assert again.put("k" * 64, {"a": np.ones(3)})  # no-op republish
+    assert again.stats()["puts"] == 0
+    assert again.get("k" * 64) is not None
+
+
+def test_corrupt_entry_quarantined_and_read_as_miss(tmp_path, capsys):
+    store = FeatureCache(str(tmp_path / "c"))
+    store.put("k" * 64, {"a": np.ones(8)})
+    path = _entry_files(store)[0]
+    with open(path, "r+b") as f:  # flip bytes mid-file: checksum mismatch
+        f.seek(30)
+        f.write(b"\xff\xff\xff\xff")
+    assert store.get("k" * 64) is None
+    assert store.quarantined == 1 and not _entry_files(store)
+    q = glob.glob(os.path.join(store.cache_dir, "quarantine", "*.npz"))
+    assert len(q) == 1  # kept for the operator, invisible to lookups
+    assert "CacheError" in capsys.readouterr().err
+    # the key is publishable again (extraction repairs the cache)
+    assert store.put("k" * 64, {"a": np.ones(8)})
+    assert store.get("k" * 64) is not None
+
+
+def test_lru_eviction_honors_byte_cap_and_hit_recency(tmp_path):
+    arr = {"a": np.zeros(64, np.float64)}  # ~1 KB serialized
+    store = FeatureCache(str(tmp_path / "c"))
+    store.put("a" * 64, arr)
+    entry = store.stats()["total_bytes"]
+    capped = FeatureCache(str(tmp_path / "cap"),
+                          max_bytes=int(entry * 2.5))  # room for 2 entries
+    def _age(key_char, mtime):  # deterministic ages, immune to fs clock
+        d = os.path.join(capped.cache_dir, key_char * 2)
+        for name in os.listdir(d):
+            os.utime(os.path.join(d, name), (mtime, mtime))
+
+    now = 1_000_000_000
+    capped.put("a" * 64, arr)
+    _age("a", now)
+    capped.put("b" * 64, arr)
+    _age("b", now + 10)
+    assert capped.get("a" * 64) is not None  # refreshes a's recency (utime)
+    capped.put("c" * 64, arr)  # over cap: LRU (b) evicted, a survived
+    assert capped.evictions == 1
+    assert capped.get("b" * 64) is None
+    assert capped.get("a" * 64) is not None
+    assert capped.get("c" * 64) is not None
+    assert capped.stats()["total_bytes"] <= capped.max_bytes
+
+
+def test_oversized_single_entry_degrades_to_pass_through(tmp_path):
+    store = FeatureCache(str(tmp_path / "c"), max_bytes=16)
+    assert store.put("a" * 64, {"a": np.zeros(64)})  # alone over the cap
+    assert store.get("a" * 64) is not None  # never evicts the only entry
+
+
+# ---- run-loop integration: zero device steps, manifests, resume ------------
+
+
+def test_cache_hit_zero_dispatch_byte_parity_and_done_manifest(tmp_path,
+                                                              corpus):
+    """Acceptance: a hit produces byte-identical .npy output to a cold
+    extraction with ZERO jit dispatches, and still writes done-manifest
+    entries — pinned so --resume and the cache interact deterministically."""
+    cold = Counting(_cfg(tmp_path, "cold"))
+    assert cold.run(corpus) == len(corpus)
+    assert cold.dispatches > 0
+    assert cold._cache.stats()["puts"] == len(corpus)
+
+    warm = Counting(_cfg(tmp_path, "warm"))
+    assert warm.run(corpus) == len(corpus)
+    assert warm.dispatches == 0  # the whole point of the subsystem
+    assert warm._cache.stats()["hits"] == len(corpus)
+    _assert_bytes_equal(_outputs(tmp_path, "warm"), _outputs(tmp_path, "cold"))
+    # cache-hit videos are marked done exactly like extracted ones …
+    done = load_done_set(str(tmp_path / "warm" / "resnet50"))
+    assert done == {os.path.abspath(p) for p in corpus}
+    # … so a --resume rerun of the SAME tree skips them without a single
+    # cache lookup (resume wins before the consult; deterministic layering)
+    resumed = Counting(_cfg(tmp_path, "warm", resume=True))
+    assert resumed.run(corpus) == len(corpus)
+    assert resumed.dispatches == 0
+    assert resumed._cache.stats()["hits"] == 0
+    assert resumed._cache.stats()["misses"] == 0
+
+
+def test_packed_loop_consults_cache_before_decode(tmp_path, corpus):
+    cold = Counting(_cfg(tmp_path, "pcold", pack_corpus=True))
+    assert cold.run(corpus) == len(corpus)
+    warm = Counting(_cfg(tmp_path, "pwarm", pack_corpus=True))
+    assert warm.run(corpus) == len(corpus)
+    assert warm.dispatches == 0
+    assert warm._pack_stats["dispatched_slots"] == 0  # nothing entered the packer
+    _assert_bytes_equal(_outputs(tmp_path, "pwarm"),
+                        _outputs(tmp_path, "pcold"))
+
+
+def test_unhashable_video_is_a_plain_miss_with_classified_failure(tmp_path,
+                                                                  corpus):
+    missing = str(tmp_path / "gone.mp4")
+    ex = Counting(_cfg(tmp_path, "miss"))
+    assert ex.run([corpus[0], missing]) == 1
+    assert ex._cache.stats()["misses"] == 1  # only the real video consulted
+    assert os.path.abspath(missing) in load_failures(ex.output_dir)
+
+
+def test_cache_disabled_is_the_default(tmp_path, corpus):
+    ex = Counting(_cfg(tmp_path, "off", cache_dir=None))
+    assert ex._cache is None
+    assert ex.run(corpus[:1]) == 1
+    assert ex.dispatches > 0
+
+
+# ---- serving daemon: in-flight coalescing ----------------------------------
+
+
+class TracingToy(ToyPacked):
+    """Records every clip-stream open — the daemon-side 'extraction ran'
+    probe (a coalesced waiter must never open its stream)."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.opened = []
+
+    def pack_spec(self):
+        spec = super().pack_spec()
+        inner = spec.open_clips
+
+        def open_clips(path):
+            self.opened.append(os.path.abspath(path))
+            return inner(path)
+
+        spec.open_clips = open_clips
+        return spec
+
+
+def _service(tmp_path, sub, ex_cls=TracingToy, **kw):
+    kw.setdefault("spool_dir", str(tmp_path / sub / "spool"))
+    kw.setdefault("idle_flush_sec", 0.0)
+    os.makedirs(kw["spool_dir"], exist_ok=True)
+    ex = ex_cls(_cfg(tmp_path, sub, serve=True, **kw))
+    return ExtractionService(ex, poll_interval=0.001)
+
+
+def _dup_corpus(tmp_path, corpus):
+    """alice.mp4 and bob.mp4: different paths, identical container bytes."""
+    a = str(tmp_path / "alice.mp4")
+    b = str(tmp_path / "bob.mp4")
+    shutil.copyfile(corpus[1], a)
+    shutil.copyfile(corpus[1], b)
+    return a, b
+
+
+def test_concurrent_identical_requests_extract_once_byte_parity(tmp_path,
+                                                                corpus):
+    """Acceptance: two tenants submit the same bytes concurrently → ONE
+    extraction runs; both receive done result records and byte-identical
+    outputs (each under its own stem)."""
+    a, b = _dup_corpus(tmp_path, corpus)
+    svc = _service(tmp_path, "co")
+    ra = svc.submit({"tenant": "alice", "videos": [a]})
+    rb = svc.submit({"tenant": "bob", "videos": [b]})
+    svc.request_drain()
+    assert svc.run() == 0
+    assert ra.state == "done" and rb.state == "done"
+    opened = svc.ex.opened
+    assert len([p for p in opened if p in (os.path.abspath(a),
+                                           os.path.abspath(b))]) == 1, opened
+    assert svc._coalescer.coalesced == 1
+    assert ra.cache_hits + rb.cache_hits == 1  # the waiter replayed as a hit
+    outs = _outputs(tmp_path, "co")
+    assert outs["alice_feat.npy"].tobytes() == outs["bob_feat.npy"].tobytes()
+    # parity against a clean batch extraction of the same content
+    ref = ToyPacked(_cfg(tmp_path, "co_ref"))
+    assert ref.run([a]) == 1
+    assert (outs["alice_feat.npy"].tobytes()
+            == _outputs(tmp_path, "co_ref")["alice_feat.npy"].tobytes())
+
+
+def test_leader_failure_requeues_waiters_not_their_breakers(tmp_path, corpus,
+                                                            monkeypatch):
+    """alice's extraction (the coalesce leader) fails permanently; bob's
+    identical waiter must requeue, lead its own extraction, and succeed —
+    with NOTHING charged to bob's breaker (failure attribution)."""
+    a, b = _dup_corpus(tmp_path, corpus)
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:alice")
+    svc = _service(tmp_path, "fail", tenant_max_failures=0)
+    ra = svc.submit({"tenant": "alice", "videos": [a]})
+    rb = svc.submit({"tenant": "bob", "videos": [b]})
+    svc.request_drain()
+    assert svc.run() == 1  # alice's terminal failure keeps the exit honest
+    assert ra.state == "failed" and rb.state == "done"
+    assert svc.breaker.tripped("alice") and not svc.breaker.tripped("bob")
+    # bob led his own extraction after alice's failed
+    assert os.path.abspath(b) in svc.ex.opened
+    assert rb.cache_hits == 0
+    assert _outputs(tmp_path, "fail")["bob_feat.npy"].size > 0
+
+
+def test_daemon_stats_expose_cache_and_bucket_occupancy(tmp_path, corpus):
+    svc = _service(tmp_path, "stats")
+    r = svc.submit({"videos": corpus[:2]})
+    for _ in range(300):
+        svc.step()
+        if r.complete:
+            break
+    stats = svc.stats()
+    assert stats["cache"]["enabled"] is True
+    assert stats["cache"]["misses"] == 2 and "hit_rate" in stats["cache"]
+    assert stats["cache"]["coalesced"] == 0
+    assert "buckets" in stats["packing"]
+    for bucket in stats["packing"]["buckets"].values():
+        assert {"real_slots", "dispatched_slots",
+                "occupancy", "stale_flushes"} <= set(bucket)
+    # resubmit the same content under new paths: pure hits
+    a, b = _dup_corpus(tmp_path, corpus)
+    shutil.copyfile(corpus[0], a)  # a = content of corpus[0] (cached above)
+    r2 = svc.submit({"videos": [a]})
+    for _ in range(300):
+        svc.step()
+        if r2.complete:
+            break
+    assert r2.state == "done" and r2.cache_hits == 1
+    assert svc.stats()["cache"]["hits"] == 1
+    svc.close()
+
+
+def test_coalescer_unit():
+    c = InflightCoalescer()
+    c.lead("k1", "/a")
+    assert c.leader_of("k1") == "/a"
+    assert c.wait("k1", "job-b") and c.wait("k1", "job-c")
+    assert not c.wait("k2", "job-d")  # nothing in flight for k2
+    assert c.waiting() == 2 and c.coalesced == 2
+    assert c.finish("/a") == ["job-b", "job-c"]
+    assert c.finish("/a") == []  # idempotent
+    assert c.waiting() == 0 and c.leader_of("k1") is None

@@ -5,10 +5,14 @@ The CI-runnable end-to-end check for the always-on daemon (docs/serving.md),
 driving the REAL CLI surface as an operator would — no test harness imports:
 
 1. two per-tenant batch CLI runs produce the reference outputs;
-2. a daemon subprocess (``--serve``, spool ingest, real signals) serves the
-   same videos as two tenant requests dropped into the spool;
-3. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
-   records for both requests, a complete done-manifest, and byte-identical
+2. a daemon subprocess (``--serve``, spool ingest, real signals, a
+   ``--cache_dir`` feature cache) serves the same videos as two tenant
+   requests dropped into the spool;
+3. a RESUBMIT of alice's videos must be served entirely from the feature
+   cache (``cache_hits`` in its result record, hits in the socket ``stats``
+   op — docs/caching.md);
+4. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
+   records for every request, a complete done-manifest, and byte-identical
    ``.npy`` outputs against the batch runs.
 
 Runs on CPU with deterministic random weights::
@@ -22,6 +26,7 @@ import glob
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import tempfile
@@ -55,6 +60,40 @@ def outputs(out_dir):
             for p in glob.glob(os.path.join(out_dir, "resnet50", "*.npy"))}
 
 
+def sock_op(sock_path, op):
+    """One line-JSON round-trip on the daemon's control socket (stdlib only,
+    like the rest of this operator-shaped script)."""
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+        s.settimeout(10.0)
+        s.connect(sock_path)
+        s.sendall(json.dumps(op).encode() + b"\n")
+        buf = b""
+        while b"\n" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            buf += chunk
+    return json.loads(buf.split(b"\n", 1)[0].decode())
+
+
+def drop_request(spool, request_id, payload):
+    tmp = os.path.join(spool, f".{request_id}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, os.path.join(spool, f"{request_id}.json"))
+
+
+def await_results(daemon, paths, deadline):
+    while time.time() < deadline:
+        if daemon.poll() is not None:
+            raise AssertionError(
+                f"daemon exited early with {daemon.returncode}")
+        if all(os.path.exists(p) for p in paths):
+            return
+        time.sleep(0.2)
+    raise AssertionError("timed out waiting for result records")
+
+
 def main() -> int:
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "VFT_ALLOW_RANDOM_WEIGHTS": "1"}
@@ -76,27 +115,17 @@ def main() -> int:
     print("[smoke] starting the daemon")
     daemon = subprocess.Popen(
         cli(serve_out, "--serve", "--spool_dir", spool,
-            "--idle_flush_sec", "0.05", "--spool_poll_sec", "0.05"),
+            "--idle_flush_sec", "0.05", "--spool_poll_sec", "0.05",
+            "--cache_dir", os.path.join(root, "cache")),
         env=env)
     try:
         for tenant, vids in videos.items():
-            tmp = os.path.join(spool, f".{tenant}.tmp")
-            with open(tmp, "w") as f:
-                json.dump({"tenant": tenant, "videos": vids}, f)
-            os.replace(tmp, os.path.join(spool, f"req_{tenant}.json"))
+            drop_request(spool, f"req_{tenant}",
+                         {"tenant": tenant, "videos": vids})
 
         results = {t: os.path.join(spool, "results", f"req_{t}.result.json")
                    for t in videos}
-        deadline = time.time() + TIMEOUT
-        while time.time() < deadline:
-            if daemon.poll() is not None:
-                raise AssertionError(
-                    f"daemon exited early with {daemon.returncode}")
-            if all(os.path.exists(p) for p in results.values()):
-                break
-            time.sleep(0.2)
-        else:
-            raise AssertionError("timed out waiting for result records")
+        await_results(daemon, results.values(), time.time() + TIMEOUT)
 
         for tenant, path in results.items():
             with open(path) as f:
@@ -106,6 +135,24 @@ def main() -> int:
                 os.path.abspath(v) for v in videos[tenant]), record
             print(f"[smoke] request {tenant}: done "
                   f"({len(record['done'])} videos)")
+
+        # resubmit alice's videos: the feature cache must serve every one
+        # (zero device steps) and say so in the result record + stats op
+        print("[smoke] resubmitting alice's videos (expect cache hits)")
+        drop_request(spool, "req_alice2",
+                     {"tenant": "alice", "videos": videos["alice"]})
+        resubmit = os.path.join(spool, "results", "req_alice2.result.json")
+        await_results(daemon, [resubmit], time.time() + TIMEOUT)
+        with open(resubmit) as f:
+            record = json.load(f)
+        assert record["state"] == "done", record
+        assert record["cache_hits"] == len(videos["alice"]), record
+        stats = sock_op(os.path.join(spool, "control.sock"), {"op": "stats"})
+        assert stats["cache"]["hits"] >= len(videos["alice"]), stats["cache"]
+        assert stats["cache"]["hit_rate"] > 0, stats["cache"]
+        print(f"[smoke] resubmit served from cache "
+              f"({record['cache_hits']} hits; cumulative hit rate "
+              f"{stats['cache']['hit_rate']:.0%})")
 
         print("[smoke] SIGTERM → graceful drain")
         daemon.send_signal(signal.SIGTERM)
@@ -123,7 +170,11 @@ def main() -> int:
         assert got[name].tobytes() == want[name].tobytes(), \
             f"{name}: daemon output differs from the batch run"
     manifest = os.path.join(serve_out, "resnet50", ".done_manifest.jsonl")
-    assert sum(1 for _ in open(manifest)) == 4, "done-manifest incomplete"
+    # cache-hit replays append their own records (resume-vs-cache layering
+    # is deterministic), so count DISTINCT videos, not lines
+    with open(manifest) as f:
+        done = {json.loads(line)["video"] for line in f}
+    assert len(done) == 4, f"done-manifest incomplete: {sorted(done)}"
     print(f"[smoke] PASS: {len(want)} outputs byte-identical, "
           "manifests intact")
     return 0

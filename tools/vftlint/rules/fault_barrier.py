@@ -25,6 +25,7 @@ from ..core import Finding, Rule, register
 
 # Declared barriers: package-relative posix path -> expected broad-catch count.
 ALLOWED: Dict[str, int] = {
+    "video_features_tpu/cache/store.py": 2,        # read + publish: a cache entry of ANY state must degrade to a miss / pass-through, never crash the video it would have saved
     "video_features_tpu/extractors/base.py": 6,    # per-video fault barrier (per-video + packed loops) + packed finalize + corpus-flush arms + async-write reap arm + unwind-path write accounting
     "video_features_tpu/extractors/flow.py": 3,    # async-copy + imshow probes + precompile warmup
     "video_features_tpu/io/output.py": 1,          # writer thread: error stored on the WriteHandle
@@ -33,7 +34,7 @@ ALLOWED: Dict[str, int] = {
     "video_features_tpu/reliability/retry.py": 2,  # classified re-raise + attempts attr
     "video_features_tpu/reliability/watchdog.py": 1,  # hands the exception to the waiter
     "video_features_tpu/run.py": 1,                # best-effort JAX_PLATFORMS shim
-    "video_features_tpu/serve/daemon.py": 3,       # per-video isolation point (serving loop) + best-effort rejection/result records (the daemon must outlive a full notify disk)
+    "video_features_tpu/serve/daemon.py": 4,       # per-video isolation point (serving loop) + cache-hit write arm + best-effort rejection/result records (the daemon must outlive a full notify disk)
     "video_features_tpu/serve/ingest.py": 1,       # one bad socket client must not kill the API thread
 }
 

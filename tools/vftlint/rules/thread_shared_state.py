@@ -31,7 +31,12 @@ The declared sites and their disciplines:
 targets publish through list-append / Event-set / queue operations only —
 no shared stores to declare. ``parallel/packer.py`` (the corpus clip packer)
 spawns NO threads by design: its one consumer thread owns all packing state,
-and its cross-thread traffic rides the pipeline/output seams above.
+and its cross-thread traffic rides the pipeline/output seams above. The
+feature cache (``cache/``) likewise spawns no threads and needs no
+declarations: the store and the in-flight coalescer are owned by the run
+loop / daemon thread (cache publishes happen inline in ``_submit_outputs``,
+BEFORE the async writer takes the job), and cross-process cache sharing
+rides atomic renames, not shared memory.
 """
 
 from __future__ import annotations

@@ -1,0 +1,56 @@
+"""Content-addressed feature cache (ROADMAP item 5, the production story).
+
+At heavy traffic most uploads are duplicates: the cheapest device step is the
+one never dispatched. This package maps ``sha256(container bytes) × model-
+config fingerprint → finished feature dict`` so a repeated video costs one
+hash and one read — zero decode, zero device steps — the same work-reuse
+instinct that drives prefix/page reuse in Ragged Paged Attention and the
+persistent artifact stores of production ML systems (PAPERS.md).
+
+Pieces:
+
+- :mod:`.key` — the cache key: a streaming content digest combined with a
+  fingerprint over exactly the config fields that affect feature numerics
+  (every :class:`..config.ExtractionConfig` field is classified fingerprint
+  vs execution, pinned by tests/test_cache.py so adding a flag forces a
+  keying decision) plus a weights-version component.
+- :mod:`.store` — the on-disk CAS: atomic tmp+rename publish (the
+  ``io/output.py`` discipline), checksum-verified reads where a corrupt
+  entry is quarantined and treated as a miss (classified
+  :class:`..reliability.CacheError`, never a crash), and size-capped LRU
+  eviction behind ``--cache_dir`` / ``--cache_max_bytes``.
+- :mod:`.coalesce` — in-flight dedup for the serving daemon: N tenants
+  submitting identical content run ONE extraction; waiters replay from the
+  fresh entry, and a leader failure requeues them instead of poisoning
+  innocent tenants' breakers.
+
+Integration lives at both entry points: the batch run loops
+(:mod:`..extractors.base`) consult the cache before decode and publish on
+the shared output path (cache-hit videos still write done-manifest entries,
+so ``--resume`` composes deterministically), and the daemon
+(:mod:`..serve.daemon`) adds the coalescing layer. See docs/caching.md.
+"""
+
+from .coalesce import InflightCoalescer
+from .key import (
+    EXECUTION_FIELDS,
+    FINGERPRINT_FIELDS,
+    cache_key,
+    config_fingerprint,
+    file_digest,
+    fingerprint_digest,
+    weights_fingerprint,
+)
+from .store import FeatureCache
+
+__all__ = [
+    "EXECUTION_FIELDS",
+    "FINGERPRINT_FIELDS",
+    "FeatureCache",
+    "InflightCoalescer",
+    "cache_key",
+    "config_fingerprint",
+    "file_digest",
+    "fingerprint_digest",
+    "weights_fingerprint",
+]

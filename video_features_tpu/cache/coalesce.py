@@ -1,0 +1,73 @@
+"""In-flight request coalescing: N identical extractions become one.
+
+With PR 6's always-on daemon, identical content arrives CONCURRENTLY from
+different tenants — finished-work dedup (the CAS store) is not enough,
+because the second request lands while the first is still on the mesh. This
+tracker maps a live cache key to its **leader** (the path whose extraction
+is running) and parks every later identical submission as a **waiter**.
+
+Contract (enforced by :mod:`..serve.daemon`, pinned by tests/test_cache.py):
+
+- exactly one extraction runs per (content, fingerprint) at a time;
+- when the leader resolves — success OR failure — :meth:`finish` hands the
+  waiters back and the daemon re-enqueues them with their original admission
+  seq. On success they replay as cache hits (zero device steps, their own
+  output stems, done-manifest and result records); on failure the first
+  replayed waiter becomes the next leader and extracts on its OWN retry
+  budget — a leader's fault is never charged to a waiter's tenant breaker;
+- quota and fairness are charged per waiter: each parked video was admitted
+  against its tenant's quota and each replay is a scheduler pop that
+  advances its tenant's virtual time.
+
+Single-writer by design: only the daemon loop MUTATES this state (no locks;
+vftlint thread-shared-state has nothing to declare for cache/). The one
+cross-thread read is :meth:`InflightCoalescer.waiting` from the serve
+socket's stats op, which snapshots before iterating.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class InflightCoalescer:
+    """Leader/waiter tracking keyed by cache key."""
+
+    def __init__(self):
+        self._by_key: Dict[str, dict] = {}   # key -> {leader, waiters}
+        self._leader_key: Dict[str, str] = {}  # leader path -> key
+        self.coalesced = 0  # cumulative waiters parked (stats op)
+
+    def lead(self, key: str, path: str) -> None:
+        """Record ``path`` as the one extraction in flight for ``key``."""
+        self._by_key[key] = {"leader": path, "waiters": []}
+        self._leader_key[path] = key
+
+    def wait(self, key: str, job) -> bool:
+        """Park ``job`` behind an in-flight identical extraction; False when
+        no extraction is in flight for ``key`` (caller should lead)."""
+        entry = self._by_key.get(key)
+        if entry is None:
+            return False
+        entry["waiters"].append(job)
+        self.coalesced += 1
+        return True
+
+    def finish(self, path: str) -> List:
+        """Leader ``path`` resolved: clear the key, return its waiters
+        (empty for non-leaders — safe to call for every completed video)."""
+        key = self._leader_key.pop(path, None)
+        if key is None:
+            return []
+        entry = self._by_key.pop(key, None)
+        return entry["waiters"] if entry else []
+
+    def leader_of(self, key: str) -> Optional[str]:
+        entry = self._by_key.get(key)
+        return entry["leader"] if entry else None
+
+    def waiting(self) -> int:
+        """Currently-parked waiter count (quiescence/stats). The one method
+        also read from the serve socket's API thread — list() snapshots the
+        live dict atomically before the Python-level iteration."""
+        return sum(len(e["waiters"]) for e in list(self._by_key.values()))

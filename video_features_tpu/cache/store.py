@@ -1,0 +1,238 @@
+"""On-disk content-addressed store for finished feature dicts.
+
+Layout: ``<cache_dir>/<key[:2]>/<key>.<body_sha[:16]>.npz`` — one file per
+entry, the feature dict serialized as an uncompressed ``.npz`` whose byte
+checksum is embedded in the FILE NAME. That makes every operation a
+single-file primitive:
+
+- **publish** is the ``io/output.py`` discipline — write the body to a tmp
+  name, then one atomic ``os.replace``; a crash leaves either no entry or a
+  complete one, and concurrent publishers of the same key converge on
+  identical bytes.
+- **read** re-hashes the body and compares against the name. A mismatch
+  (torn write survived a crash, bit rot, manual edits) quarantines the file
+  under ``<cache_dir>/quarantine/`` and reports a miss — classified as a
+  :class:`..reliability.CacheError` in the warning, NEVER a crash: the
+  extraction path simply recomputes and republishes.
+- **LRU eviction**: a hit touches the entry's mtime; when a publish pushes
+  the tracked total past ``max_bytes``, the oldest-mtime entries are removed
+  until the cap holds (the just-published entry is never evicted, so a
+  single oversized entry degrades to cache-through rather than thrashing).
+
+Thread/process posture: one store instance is owned by the run-loop (or
+daemon) thread — no locks, no threads spawned (vftlint thread-shared-state:
+nothing to declare). Across PROCESSES sharing a cache directory, atomic
+renames make publishes safe and a reader racing an eviction sees a plain
+miss; the byte cap is per-process approximate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import sys
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+from ..io.output import atomic_write_bytes
+from ..reliability import CacheError, classify
+
+_BODY_DIGEST_LEN = 16
+
+
+def _entry_rel(key: str, body_digest: str) -> str:
+    return os.path.join(key[:2], f"{key}.{body_digest}.npz")
+
+
+class FeatureCache:
+    """Size-capped CAS: ``key → {name: np.ndarray}`` with LRU eviction."""
+
+    def __init__(self, cache_dir: str, max_bytes: Optional[int] = None):
+        self.cache_dir = cache_dir
+        self.max_bytes = max_bytes
+        self.quarantine_dir = os.path.join(cache_dir, "quarantine")
+        os.makedirs(cache_dir, exist_ok=True)
+        # path -> size for every live entry; seeds the byte cap from disk so
+        # restarts keep honoring it
+        self._entries: Dict[str, int] = {}
+        self._total_bytes = 0
+        self._scan()
+        # cumulative counters (the run report / serve stats op surface)
+        self.hits = 0
+        self.misses = 0
+        self.hit_bytes = 0
+        self.puts = 0
+        self.put_bytes = 0
+        self.evictions = 0
+        self.evicted_bytes = 0
+        self.quarantined = 0
+
+    # --- read ----------------------------------------------------------------
+
+    def get(self, key: str) -> Optional[Dict[str, np.ndarray]]:
+        """The cached feature dict for ``key``, or None (miss). Never raises:
+        unreadable and corrupt entries are quarantined misses."""
+        path = self._find(key)
+        if path is None:
+            self.misses += 1
+            return None
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            want = os.path.basename(path).rsplit(".", 2)[1]
+            got = hashlib.sha256(data).hexdigest()[:_BODY_DIGEST_LEN]
+            if got != want:
+                raise CacheError(
+                    f"checksum mismatch (name {want}, bytes {got})")
+            with np.load(io.BytesIO(data)) as z:
+                feats = {name: z[name] for name in z.files}
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — fault-barrier: a cache entry of ANY state must read as a miss, never crash the run
+            self._quarantine(path, e)
+            self.misses += 1
+            return None
+        try:  # LRU recency; best-effort (a read-only mount still serves hits)
+            os.utime(path)
+        except OSError:
+            pass
+        self.hits += 1
+        self.hit_bytes += len(data)
+        return feats
+
+    # --- write ---------------------------------------------------------------
+
+    def put(self, key: str, feats_dict: Mapping[str, np.ndarray]) -> bool:
+        """Publish ``feats_dict`` under ``key``; True when an entry is live
+        afterwards. Never raises: a cache that cannot write degrades to a
+        pass-through (warn once per failure), it must not fail the video."""
+        existing = self._find(key)
+        if existing is not None:
+            return True  # same key ⇒ same inputs ⇒ same bytes; keep it
+        try:
+            buf = io.BytesIO()
+            np.savez(buf, **{name: np.asarray(v)
+                             for name, v in feats_dict.items()})
+            data = buf.getvalue()
+            body = hashlib.sha256(data).hexdigest()[:_BODY_DIGEST_LEN]
+            path = os.path.join(self.cache_dir, _entry_rel(key, body))
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            atomic_write_bytes(path, data)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — fault-barrier: publish is best-effort; a full/broken cache disk must not fail the video it caches
+            err_class, _ = classify(CacheError(str(e)))
+            print(f"warning: [{err_class}] could not publish cache entry "
+                  f"{key[:12]}…: {e}", file=sys.stderr)
+            return False
+        self.puts += 1
+        self.put_bytes += len(data)
+        self._entries[path] = len(data)
+        self._total_bytes += len(data)
+        self._evict(keep=path)
+        return True
+
+    # --- internals -----------------------------------------------------------
+
+    def _find(self, key: str) -> Optional[str]:
+        d = os.path.join(self.cache_dir, key[:2])
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return None
+        prefix = key + "."
+        for name in sorted(names):
+            if name.startswith(prefix) and name.endswith(".npz"):
+                return os.path.join(d, name)
+        return None
+
+    def _quarantine(self, path: str, exc: BaseException) -> None:
+        err_class, _ = classify(
+            exc if isinstance(exc, CacheError) else CacheError(str(exc)))
+        dest = os.path.join(self.quarantine_dir, os.path.basename(path))
+        try:
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            os.replace(path, dest)
+            moved = f"quarantined to {dest}"
+        except OSError as move_err:
+            moved = f"could not quarantine ({move_err})"
+        self.quarantined += 1
+        self._drop_accounting(path)
+        print(f"warning: [{err_class}] corrupt cache entry "
+              f"{os.path.basename(path)}: {exc}; {moved}; treating as a miss",
+              file=sys.stderr)
+
+    def _drop_accounting(self, path: str) -> None:
+        size = self._entries.pop(path, None)
+        if size is not None:
+            self._total_bytes -= size
+
+    def _evict(self, keep: str) -> None:
+        """Oldest-mtime entries out until ``max_bytes`` holds (LRU: hits
+        touch mtime). ``keep`` (the just-published entry) is exempt."""
+        if self.max_bytes is None or self._total_bytes <= self.max_bytes:
+            return
+        by_age = []
+        for path in list(self._entries):
+            if path == keep:
+                continue
+            try:
+                by_age.append((os.path.getmtime(path), path))
+            except OSError:  # raced an external removal: drop the record
+                self._drop_accounting(path)
+        for _mtime, path in sorted(by_age):
+            if self._total_bytes <= self.max_bytes:
+                break
+            size = self._entries.get(path, 0)
+            try:
+                os.remove(path)
+            except OSError as e:
+                print(f"warning: could not evict cache entry {path}: {e}",
+                      file=sys.stderr)
+                continue
+            self._drop_accounting(path)
+            self.evictions += 1
+            self.evicted_bytes += size
+
+    def _scan(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.cache_dir):
+            if os.path.abspath(dirpath).startswith(
+                    os.path.abspath(self.quarantine_dir)):
+                continue
+            dirnames[:] = [d for d in dirnames if d != "quarantine"]
+            for name in filenames:
+                if not name.endswith(".npz"):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                self._entries[path] = size
+                self._total_bytes += size
+
+    # --- introspection -------------------------------------------------------
+
+    @property
+    def hit_rate(self) -> float:
+        lookups = self.hits + self.misses
+        return self.hits / lookups if lookups else 0.0
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "enabled": True,
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hit_rate, 4),
+            "hit_bytes": self.hit_bytes,
+            "puts": self.puts,
+            "put_bytes": self.put_bytes,
+            "evictions": self.evictions,
+            "evicted_bytes": self.evicted_bytes,
+            "quarantined": self.quarantined,
+            "entries": len(self._entries),
+            "total_bytes": self._total_bytes,
+            "max_bytes": self.max_bytes,
+        }

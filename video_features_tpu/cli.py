@@ -233,6 +233,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "occupancy when there is nothing to pack with)")
     parser.add_argument("--spool_poll_sec", type=float, default=0.25,
                         help="--serve: spool directory poll interval")
+    # Feature cache (docs/caching.md)
+    parser.add_argument("--cache_dir", default=None,
+                        help="content-addressed feature cache: "
+                             "sha256(container bytes) x model-config "
+                             "fingerprint -> finished features. A hit costs "
+                             "zero decode and zero device steps and still "
+                             "writes outputs + a done-manifest entry "
+                             "(--resume composes); the --serve daemon also "
+                             "coalesces in-flight identical requests so N "
+                             "tenants submitting the same video run one "
+                             "extraction (docs/caching.md)")
+    parser.add_argument("--cache_max_bytes", type=int, default=None,
+                        help="--cache_dir byte cap: publishing past it "
+                             "evicts the least-recently-hit entries "
+                             "(default: unbounded)")
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")

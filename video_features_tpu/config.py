@@ -225,6 +225,17 @@ class ExtractionConfig:
     idle_flush_sec: float = 0.5
     # Spool directory poll interval.
     spool_poll_sec: float = 0.25
+    # --- feature cache (docs/caching.md) ---
+    # Content-addressed feature cache directory: sha256(container bytes) ×
+    # model-config fingerprint → finished feature dict. A hit skips decode
+    # AND the device entirely (outputs + done-manifest entry still written,
+    # so --resume composes deterministically); both the batch loops and the
+    # --serve daemon consult it before decode, and the daemon additionally
+    # coalesces in-flight identical requests (cache/ package). None = off.
+    cache_dir: Optional[str] = None
+    # Byte cap for the cache directory: publishing past it evicts the
+    # least-recently-hit entries (a hit refreshes recency). None = unbounded.
+    cache_max_bytes: Optional[int] = None
     # I3D geometry: smaller-edge resize target and center-crop size. The
     # reference hard-codes 256/224 (extract_i3d.py:25 + transforms); these stay
     # the parity defaults. Overriding shrinks the SAME jitted two-stream
@@ -323,6 +334,12 @@ class ExtractionConfig:
                              "the first failure)")
         if self.idle_flush_sec < 0:
             raise ValueError("idle_flush_sec must be >= 0")
+        if self.cache_max_bytes is not None and self.cache_max_bytes < 1:
+            raise ValueError("cache_max_bytes must be >= 1 (omit for an "
+                             "unbounded cache)")
+        if self.cache_max_bytes is not None and self.cache_dir is None:
+            raise ValueError("cache_max_bytes needs --cache_dir (it caps the "
+                             "cache directory)")
         if self.spool_poll_sec <= 0:
             raise ValueError("spool_poll_sec must be > 0")
         if self.serve:

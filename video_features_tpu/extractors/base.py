@@ -103,6 +103,26 @@ class Extractor(abc.ABC):
         # --pack_corpus occupancy of the last packed run (bench/run.py report):
         # {"real_slots", "dispatched_slots", "occupancy", "video_clips"}
         self._pack_stats: Optional[Dict] = None
+        # content-addressed feature cache (--cache_dir, docs/caching.md):
+        # the config+weights fingerprint is hashed ONCE here; per-video keys
+        # combine it with each container's streaming content digest.
+        # _cache_keys remembers consult-time keys until publish (or terminal
+        # failure) so the miss → extract → publish path never re-hashes.
+        self._cache = None
+        self._cache_fp: Optional[str] = None
+        self._cache_keys: Dict[str, str] = {}
+        if cfg.cache_dir:
+            from ..cache import FeatureCache, fingerprint_digest
+
+            try:
+                self._cache_fp = fingerprint_digest(cfg)
+                self._cache = FeatureCache(cfg.cache_dir, cfg.cache_max_bytes)
+            except OSError as e:
+                # an unreadable checkpoint / cache dir disables the cache for
+                # this run (pass-through), it must not block extraction
+                print(f"warning: --cache_dir disabled: {e}", file=sys.stderr)
+                self._cache = None
+                self._cache_fp = None
 
     # --- per-model API ---
 
@@ -307,9 +327,18 @@ class Extractor(abc.ABC):
 
     def _submit_outputs(self, path: str, feats_dict: Dict[str, np.ndarray],
                         cancelled: Optional[threading.Event] = None,
-                        ) -> Optional[WriteHandle]:
+                        from_cache: bool = False) -> Optional[WriteHandle]:
         """One video's output action — shared by the per-video loop's
-        :meth:`_process_one` and the packed loop's finalize."""
+        :meth:`_process_one`, the packed loop's finalize, and the cache-hit
+        replay (``from_cache=True`` skips the republish). A freshly-extracted
+        video whose key was consulted this run publishes to the cache HERE,
+        before the (possibly async) write — by the time the write resolves,
+        concurrent duplicates already hit."""
+        if (self._cache is not None and not from_cache
+                and (cancelled is None or not cancelled.is_set())):
+            key = self._cache_keys.pop(os.path.abspath(path), None)
+            if key is not None:
+                self._cache.put(key, feats_dict)  # best-effort, never raises
         if self._writer is not None:
             # the job carries the cancel event: a timeout landing between
             # the caller's check and the writer thread picking the job up (or
@@ -325,6 +354,63 @@ class Extractor(abc.ABC):
         write_outputs(feats_dict, path, self.output_dir,
                       self.cfg.on_extraction, cancelled=cancelled)
         return None
+
+    # --- feature cache (--cache_dir, docs/caching.md) -------------------------
+
+    def _cache_key_for(self, path: str) -> Optional[str]:
+        """Compute (and remember) the cache key for ``path``; None when the
+        cache is off or the container cannot be hashed — hashing failures are
+        plain misses here, the extraction attempt owns classifying them."""
+        if self._cache is None:
+            return None
+        ap = os.path.abspath(path)
+        key = self._cache_keys.get(ap)
+        if key is not None:
+            return key
+        from ..cache import cache_key, file_digest
+
+        try:
+            key = cache_key(file_digest(path), self._cache_fp)
+        except OSError as e:
+            print(f"warning: cache skipped for {path} (cannot hash): {e}",
+                  file=sys.stderr)
+            return None
+        self._cache_keys[ap] = key
+        return key
+
+    def _cache_fetch(self, path: str) -> Optional[Dict[str, np.ndarray]]:
+        """The cached feature dict for ``path``, or None (miss/disabled).
+        Never raises: both loops call it BEFORE their fault barrier. Hash +
+        lookup time lands on the 'cache' stage of the report."""
+        if self._cache is None:
+            return None
+        if self.clock is not None:
+            with self.clock.stage("cache"):
+                key = self._cache_key_for(path)
+                feats = self._cache.get(key) if key is not None else None
+        else:
+            key = self._cache_key_for(path)
+            feats = self._cache.get(key) if key is not None else None
+        if feats is not None:
+            # the key's job is done; a hit republishes nothing
+            self._cache_keys.pop(os.path.abspath(path), None)
+        return feats
+
+    def _publish_cache_hit(self, path: str, feats: Dict[str, np.ndarray],
+                           on_done=None) -> None:
+        """Serve a hit through the SHARED output path: same atomic writes,
+        same done-manifest record (pinned — ``--resume`` must compose), same
+        pending-write accounting; zero decode, zero device steps. The caller
+        owns the fault barrier (a failed write fails this video like any
+        other write failure)."""
+        handle = self._submit_outputs(path, feats, from_cache=True)
+        if handle is not None:
+            self._pending_writes.append((path, handle))
+        else:
+            self._ok += 1
+            self._succeeded.append(path)
+            if on_done is not None:
+                on_done(path)
 
     def _attempt_with_retries(self, path: str) -> Optional[WriteHandle]:
         """Run one video under the watchdog + transient-retry policy.
@@ -410,6 +496,10 @@ class Extractor(abc.ABC):
         write reap share it so a write failure is recorded exactly like a
         compute one (classified, manifested, circuit-breaker counted)."""
         self._failures += 1
+        # drop the consult-time cache key (nothing will publish it; the
+        # daemon's requeue path, which WILL retry, claims the failure before
+        # reaching here and keeps the key so retries skip the re-hash)
+        self._cache_keys.pop(os.path.abspath(path), None)
         err_class, transient = classify(e)
         attempts = getattr(e, "attempts", 1)
         # best-effort: the manifest write hitting the same dying
@@ -494,21 +584,34 @@ class Extractor(abc.ABC):
                     if progress:
                         progress(n, len(paths))
                     continue
-                if self._decode_pool is not None:
-                    # keep `workers` videos decoding ahead of the consumer
-                    for p in todo[cursor : cursor + workers]:
-                        self._decode_pool.schedule(p)
-                    cursor += 1
                 self.clock = StageClock() if with_metrics else None
                 t0 = time.perf_counter()
+                # consult the cache BEFORE decode: a hit dispatches nothing —
+                # no decode stream, no device step (_cache_fetch never raises;
+                # a hit's WRITE failure still lands on the barrier below)
+                feats = self._cache_fetch(path)
+                if self._decode_pool is not None:
+                    if feats is None:
+                        # keep `workers` videos decoding ahead of the consumer
+                        for p in todo[cursor : cursor + workers]:
+                            self._decode_pool.schedule(p)
+                    else:
+                        # an earlier miss's window may have prefetch-scheduled
+                        # this path — cancel it, nothing will consume it
+                        self._decode_pool.release(path)
+                    cursor += 1
                 try:
-                    handle = self._attempt_with_retries(path)
-                    extracted += 1
+                    if feats is not None:
+                        self._publish_cache_hit(path, feats)
+                        handle = None  # accounted inside the helper
+                    else:
+                        handle = self._attempt_with_retries(path)
+                        extracted += 1
                     if self.clock is not None:
                         print(self.clock.report(path, time.perf_counter() - t0))
                     if handle is not None:
                         pending_writes.append((path, handle))
-                    else:
+                    elif feats is None:
                         self._ok += 1
                         self._succeeded.append(path)
                 except KeyboardInterrupt:
@@ -531,10 +634,12 @@ class Extractor(abc.ABC):
                 if progress:
                     progress(n, len(paths))
             self._reap_writes(0)  # tail videos' writes resolve before run() returns
-        if with_metrics and extracted:
+        if with_metrics and (extracted or
+                             (self._cache is not None and self._cache.hits)):
             dt = time.perf_counter() - t_run
+            hits = f", {self._cache.hits} cache hit(s)" if self._cache else ""
             print(f"extracted {extracted}/{len(paths)} videos "
-                  f"({resumed} resumed) in {dt:.2f}s "
+                  f"({resumed} resumed{hits}) in {dt:.2f}s "
                   f"({extracted / dt:.3f} videos/sec)")
         return self._ok
 
@@ -581,13 +686,22 @@ class Extractor(abc.ABC):
                     if progress:
                         progress(n, len(paths))
                     continue
+                # cache consult precedes decode here too: a hit never enters
+                # the packer (its rows were never going to dispatch)
+                feats = self._cache_fetch(path)
                 if self._decode_pool is not None:
-                    for p in todo[cursor : cursor + workers]:
-                        self._decode_pool.schedule(p)
+                    if feats is None:
+                        for p in todo[cursor : cursor + workers]:
+                            self._decode_pool.schedule(p)
+                    else:
+                        self._decode_pool.release(path)
                     cursor += 1
                 try:
-                    session.ingest(path)
-                    extracted += 1
+                    if feats is not None:
+                        self._publish_cache_hit(path, feats)
+                    else:
+                        session.ingest(path)
+                        extracted += 1
                 except KeyboardInterrupt:
                     raise
                 except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point (packed loop)
@@ -623,8 +737,9 @@ class Extractor(abc.ABC):
                     wall=dt, stale_flushes=packer.stale_flushes)
                 if starved:
                     print(starved, file=sys.stderr)
+            hits = f", {self._cache.hits} cache hit(s)" if self._cache else ""
             print(f"extracted {extracted}/{len(paths)} videos "
-                  f"({resumed} resumed) in {dt:.2f}s")
+                  f"({resumed} resumed{hits}) in {dt:.2f}s")
         self.clock = None
         return self._ok
 

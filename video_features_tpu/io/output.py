@@ -38,6 +38,27 @@ def feature_output_dir(output_path: str, feature_type: str) -> str:
     return os.path.join(output_path, feature_type)
 
 
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Publish ``data`` at ``path`` via tmp + ``os.replace`` — the shared
+    crash-safety discipline (:func:`_atomic_save`, request results, the
+    feature cache's CAS entries in ``cache/store.py``): a kill at any point
+    leaves either no visible file or a complete one. Raises
+    :class:`~..reliability.OutputError` on filesystem failure."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except OSError as e:
+        raise OutputError(f"failed to write {path}: {e}") from e
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
 def _atomic_save(fpath: str, value: np.ndarray) -> None:
     """Write ``value`` to ``fpath`` via tmp + rename; never a truncated final file.
 

@@ -448,12 +448,22 @@ class CorpusPacker:
 
     @property
     def stale_flushes(self) -> int:
-        return sum(s["stale_flushes"] for s in self._bucket_stats.values())
+        # list() snapshots atomically (C-level, under the GIL): the serve
+        # socket's stats op reads this from the API thread while the daemon
+        # thread registers new buckets — Python-level iteration over the
+        # live dict could raise "changed size during iteration"
+        return sum(s["stale_flushes"] for s in list(self._bucket_stats.values()))
 
     def bucket_stats(self) -> Dict[str, Dict[str, float]]:
-        """Per-shape-key occupancy accounting (JSON-friendly keys)."""
+        """Per-shape-key occupancy accounting (JSON-friendly keys).
+
+        Safe to call from the serve socket's API thread concurrently with
+        the packing thread: both dict levels are snapshotted with atomic
+        C-level copies before any Python-level iteration.
+        """
         out: Dict[str, Dict[str, float]] = {}
-        for key, s in sorted(self._bucket_stats.items(), key=str):
+        for key, live in sorted(dict(self._bucket_stats).items(), key=str):
+            s = dict(live)
             name = "x".join(str(d) for d in key)
             out[name] = {
                 "real_slots": s["real_slots"],

@@ -12,6 +12,7 @@ layer raises the taxonomy, :mod:`..extractors.base` runs the barrier, and
 
 from .breaker import TenantBreaker, TenantBreakerOpen
 from .errors import (
+    CacheError,
     CircuitBreakerTripped,
     DecodeError,
     DeviceError,
@@ -34,6 +35,7 @@ from .retry import RetryPolicy, retry_call
 from .watchdog import run_with_timeout
 
 __all__ = [
+    "CacheError",
     "CircuitBreakerTripped",
     "TenantBreaker",
     "TenantBreakerOpen",

@@ -69,6 +69,15 @@ class VideoTimeoutError(ExtractionError):
     transient = False
 
 
+class CacheError(ExtractionError):
+    """Feature-cache entry unreadable or corrupt (checksum mismatch, torn
+    file, broken cache disk). Transient in the taxonomy sense — the content
+    is recomputable — and by contract never escapes :mod:`..cache`: the
+    store quarantines the entry, reports a miss, and extraction proceeds."""
+
+    transient = True
+
+
 class CircuitBreakerTripped(Exception):
     """Run-level abort: more failures than ``--max_failures`` allows.
 

@@ -128,6 +128,19 @@ def main(argv=None) -> int:
                       f"{b['dispatched_slots']} slots "
                       f"({b['occupancy']:.1%}, "
                       f"stale_flushes={b['stale_flushes']})")
+    # --cache_dir: how much work the content-addressed feature cache saved
+    # (a hit = zero decode + zero device steps; docs/caching.md)
+    cache = getattr(extractor, "_cache", None)
+    if cache is not None:
+        s = cache.stats()
+        line = (f"feature cache: {s['hits']} hit(s) / {s['misses']} miss(es) "
+                f"({s['hit_rate']:.1%} hit rate), "
+                f"{s['hit_bytes'] / 1e6:.1f} MB served, "
+                f"{s['puts']} published")
+        if s["evictions"] or s["quarantined"]:
+            line += (f", {s['evictions']} evicted, "
+                     f"{s['quarantined']} quarantined")
+        print(line)
     failed = len(paths) - ok
     if failed:
         print(f"{failed} video(s) failed; classified records in "

@@ -27,6 +27,15 @@ the shared failure manifest AND the owning request's result record. Terminal
 failures count against the tenant's breaker (``--tenant_max_failures``):
 tripping fails that tenant's queued videos fast and rejects its new
 submissions until a reload, while other tenants keep completing.
+
+With ``--cache_dir`` (docs/caching.md) every popped job consults the
+content-addressed feature cache first — a hit writes outputs + manifests
+with zero decode and zero device steps — and identical MISSES coalesce
+in flight (:class:`..cache.InflightCoalescer`): N tenants submitting the
+same bytes run ONE extraction, waiters replay from the fresh entry with
+quota/fairness charged per waiter, and a leader failure re-enqueues the
+waiters (next replay leads on its own retry budget) instead of charging a
+neighbour's fault to their breakers.
 """
 
 from __future__ import annotations
@@ -39,6 +48,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..cache import InflightCoalescer
 from ..extractors.base import PackedSession
 from ..io.output import (
     load_done_set,
@@ -94,6 +104,9 @@ class ExtractionService:
         self._lock = threading.RLock()
         self._requests: Dict[str, ServiceRequest] = {}
         self._jobs: Dict[str, object] = {}  # abspath -> in-flight VideoJob
+        # in-flight dedup (--cache_dir): identical (content, fingerprint)
+        # misses run one extraction; touched only on the daemon thread
+        self._coalescer = InflightCoalescer()
         self._draining = threading.Event()
         self._hup = threading.Event()
         self._idle_since: Optional[float] = None
@@ -190,6 +203,8 @@ class ExtractionService:
             return True
         with self._lock:
             self._jobs[path] = job
+        if self._try_cache(job):
+            return True
         pool = self.ex._decode_pool
         if pool is not None:
             pool.schedule(path)
@@ -254,13 +269,70 @@ class ExtractionService:
         self.ex._close_run_resources()
         self.ex.clock = None
 
+    def _try_cache(self, job) -> bool:
+        """Feature-cache consult + in-flight coalescing for one popped job.
+
+        True when no extraction should run this step: the job was served
+        from the cache (outputs + manifests written, zero device steps) or
+        parked behind an identical in-flight extraction. Fairness holds
+        either way — the pop that got us here already advanced the tenant's
+        virtual time, and a parked waiter's replay is another pop.
+        """
+        ex = self.ex
+        if ex._cache is None:
+            return False
+        path = job.path
+        feats = ex._cache_fetch(path)
+        pool = ex._decode_pool
+        if feats is not None:
+            if pool is not None:
+                pool.release(path)  # may have been prefetch-hint scheduled
+            job.from_cache = True
+            try:
+                ex._publish_cache_hit(path, feats,
+                                      on_done=self._video_done)
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:  # noqa: BLE001 — fault-barrier: a hit's write failure is this video's own failure, owned by the shared requeue-vs-terminal logic
+                self.session.fail(path, e)
+                return True
+            self.session.emit_completed(reap_limit=1)
+            return True
+        key = ex._cache_keys.get(os.path.abspath(path))
+        if key is None:
+            return False  # unhashable content: extract without coalescing
+        if self._coalescer.wait(key, job):
+            # identical extraction already in flight: park this job — the
+            # leader's completion (or failure) re-enqueues it
+            if pool is not None:
+                pool.release(path)
+            return True
+        self._coalescer.lead(key, path)
+        return False
+
     # --- bookkeeping (PackedSession callbacks; daemon thread) ----------------
+
+    def _release_waiters(self, path: str) -> None:
+        """Leader ``path`` resolved: re-enqueue its coalesced waiters with
+        their original admission seqs (replays do not go to the back). After
+        a successful leader they replay as cache hits; after a failed one the
+        first replay becomes the next leader on its OWN retry budget — a
+        leader's fault never reaches a waiter tenant's breaker."""
+        waiters = self._coalescer.finish(path)
+        if not waiters:
+            return
+        for wjob in waiters:
+            self._jobs.pop(wjob.path, None)
+        self.queue.requeue_all(waiters)
 
     def _video_done(self, path: str) -> None:
         with self._lock:
+            self._release_waiters(path)
             job = self._jobs.pop(path, None)
             if job is None:
                 return
+            if job.from_cache:
+                job.request.cache_hits += 1
             job.request.done.append(path)
             self._maybe_finish_request(job.request)
 
@@ -275,6 +347,7 @@ class ExtractionService:
         innocent tenant's video lost to a neighbour's poisoned batch must
         not count against that tenant's breaker."""
         with self._lock:
+            self._release_waiters(path)
             job = self._jobs.pop(path, None)
             if job is None:
                 return False
@@ -325,6 +398,10 @@ class ExtractionService:
         pool = self.ex._decode_pool
         if pool is not None:
             pool.release(job.path)  # may have been prefetch-scheduled
+        # a fast-failed ex-waiter still holds its consult-time cache key
+        # (abspath-keyed, matching the memo — job.path is absolute by
+        # admission, the abspath here is belt-and-braces)
+        self.ex._cache_keys.pop(os.path.abspath(job.path), None)
         with self._lock:
             job.request.failed.append({
                 "video": job.path, "error_class": "TenantBreakerOpen",
@@ -429,7 +506,16 @@ class ExtractionService:
                     "real_slots": self.packer.real_slots,
                     "dispatched_slots": self.packer.dispatched_slots,
                     "occupancy": round(self.packer.occupancy, 4),
+                    # per-shape-bucket occupancy (operators watch a rare
+                    # bucket starving without tailing the daemon log)
+                    "buckets": self.packer.bucket_stats(),
+                    "stale_flushes": self.packer.stale_flushes,
                 },
+                "cache": (dict(self.ex._cache.stats(),
+                               coalesced=self._coalescer.coalesced,
+                               waiting=self._coalescer.waiting())
+                          if self.ex._cache is not None
+                          else {"enabled": False}),
                 "decode_workers": pool.workers if pool is not None else 0,
                 "tenants": self.queue.stats(),
                 "breaker_open": list(self.breaker.open_tenants()),

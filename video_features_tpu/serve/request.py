@@ -40,16 +40,19 @@ class VideoJob:
     ``attempts`` counts terminal-attempt failures so transient errors can
     re-enter the queue (:meth:`..serve.scheduler.RequestQueue.requeue`)
     instead of sleeping a backoff inside the serving loop; ``seq`` is the
-    queue's global admission counter (FIFO tiebreak within a tenant).
+    queue's global admission counter (FIFO tiebreak within a tenant);
+    ``from_cache`` marks a video served from the feature cache (zero device
+    steps) so the request's result record can report its hit count.
     """
 
-    __slots__ = ("path", "request", "seq", "attempts")
+    __slots__ = ("path", "request", "seq", "attempts", "from_cache")
 
     def __init__(self, path: str, request: "ServiceRequest", seq: int = 0):
         self.path = path
         self.request = request
         self.seq = seq
         self.attempts = 0
+        self.from_cache = False
 
     @property
     def deadline(self) -> Optional[float]:
@@ -74,6 +77,7 @@ class ServiceRequest:
         self.submitted_at = time.time()
         self.done: List[str] = []
         self.failed: List[Dict] = []  # {video, error_class, transient, message}
+        self.cache_hits = 0  # done videos served from the feature cache
 
     @property
     def complete(self) -> bool:
@@ -94,6 +98,7 @@ class ServiceRequest:
             "state": self.state,
             "videos": len(self.videos),
             "done": sorted(self.done),
+            "cache_hits": self.cache_hits,
             "failed": sorted(self.failed, key=lambda r: r["video"]),
             "deadline": self.deadline,
             "submitted_at": self.submitted_at,

@@ -167,12 +167,24 @@ class RequestQueue:
         daemon). Keeps its original admission seq, so it schedules ahead of
         later submissions — a retry should not go to the back of the line."""
         with self._lock:
-            t = self._tenant(job.request.tenant)
-            was_idle = not t.heap
-            heapq.heappush(t.heap, (*job.sort_key(), job))
-            self._queued_paths.add(job.path)
-            if was_idle:
-                t.vtime = max(t.vtime, self._vclock)
+            self._requeue_locked(job)
+
+    def requeue_all(self, jobs: List[VideoJob]) -> None:
+        """Batch :meth:`requeue` under one lock acquisition — how the daemon
+        releases a coalesced leader's waiters (cache/coalesce.py): each
+        replay keeps its admission seq, so a video that waited on another
+        tenant's identical extraction is not also sent to the back."""
+        with self._lock:
+            for job in jobs:
+                self._requeue_locked(job)
+
+    def _requeue_locked(self, job: VideoJob) -> None:
+        t = self._tenant(job.request.tenant)
+        was_idle = not t.heap
+        heapq.heappush(t.heap, (*job.sort_key(), job))
+        self._queued_paths.add(job.path)
+        if was_idle:
+            t.vtime = max(t.vtime, self._vclock)
 
     # --- scheduling ----------------------------------------------------------
 
