@@ -70,6 +70,7 @@ _FAST_MODULES = {
     "test_flow_sharded",
     "test_fps_resampler",
     "test_golden_pipeline",
+    "test_ingest",
     "test_mirror_independence",
     "test_packer",
     "test_packer_buckets",

@@ -148,8 +148,11 @@ def test_extract_flow_routes_sharded_precompiles_and_matches_pair(tmp_path):
     ex._start_precompile(width=40, height=32)
     assert ex._precompiled == {(32, 40)}
 
-    frames = np.random.default_rng(5).uniform(
-        0, 255, (3, 32, 40, 3)).astype(np.float32)
+    # uint8 frames: the wire dtype the precompile warmed — a float32 window
+    # would compile a SECOND (non-production) program and fail the
+    # cache-size assertions below
+    frames = np.random.default_rng(5).integers(
+        0, 256, (3, 32, 40, 3), dtype=np.uint8)
     flow = ex._run_pairs(frames)
     assert flow.shape == (2, 2, 32, 40)
     assert ex._frames_step_sharded._cache_size() == 1  # no second compile

@@ -52,6 +52,7 @@ FINGERPRINT_FIELDS = (
     "pack_buckets",            # resolved: bucket merging geometry
     "i3d_pre_crop_size",       # i3d resize target
     "i3d_crop_size",           # i3d center crop
+    "device_resize",           # resolved: jax.image.resize vs PIL drifts
 )
 
 # Fields declared NOT to affect feature bytes. Each carries its reason; the
@@ -64,6 +65,8 @@ EXECUTION_FIELDS = (
     "on_extraction",           # print vs save — same arrays
     "output_path",             # where results land
     "batch_size",              # per-slot parity pinned (tests/test_packer*)
+    "float32_wire",            # u8->fp32 cast is exact; staged bytes only
+                               # (byte parity pinned by tests/test_ingest.py)
     "show_pred",               # extra prints; features unchanged
     "clips_per_batch",         # batching, parity pinned
     "num_devices",             # data-parallel sharding, parity pinned
@@ -159,6 +162,11 @@ def config_fingerprint(cfg) -> Dict[str, object]:
             value = _resolve_use_ffmpeg(cfg)
         elif name in ("shape_bucket", "pack_corpus", "pack_buckets"):
             value = value if flow else None
+        elif name == "device_resize":
+            # only resnet50 has a device-resize path; other feature types
+            # print a notice and keep the (parity) host resize, so the flag
+            # must not split their keys
+            value = bool(value) if cfg.feature_type == "resnet50" else False
         elif isinstance(value, tuple):
             value = list(value)
         fp[name] = value

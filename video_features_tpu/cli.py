@@ -93,6 +93,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "of this size to bound HBM (default: auto; 0 = never; "
                              "PWC only — the RAFT sandwich bounds memory via "
                              "--raft_corr auto instead)")
+    parser.add_argument("--float32_wire", action="store_true", default=False,
+                        help="flow models: stage frame windows as float32 on "
+                             "the host (the pre-uint8 wire format) — 4x the "
+                             "host->device bytes for byte-identical outputs; "
+                             "A/B escape hatch and the bench baseline "
+                             "(docs/performance.md ingest fast path)")
+    parser.add_argument("--device_resize", action="store_true", default=False,
+                        help="resnet50: ship RAW decoded frames and run the "
+                             "edge resize + center crop inside the jitted "
+                             "step (jax.image.resize) — removes the host PIL "
+                             "resize cost; NOT bit-identical to the PIL path "
+                             "(documented tolerance, docs/performance.md); "
+                             "off = bit-parity")
     parser.add_argument("--transfer_dtype", default="float32",
                         choices=["float32", "float16", "bfloat16"],
                         help="raft/pwc: cast dense flow to this on device "

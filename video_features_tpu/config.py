@@ -166,6 +166,24 @@ class ExtractionConfig:
     # TPU fp32 convs default to bf16 MXU passes; "highest" gives true-fp32
     # accumulation for the bit-parity path (None = XLA default).
     matmul_precision: Optional[str] = None
+    # Host wire-format escape hatch (flow extractors): stage frame windows as
+    # float32 on the host — the pre-uint8 behavior — instead of shipping the
+    # decoded uint8 bytes and casting inside the jitted step. 4× the
+    # host→device bytes and host staging churn for IDENTICAL output bytes
+    # (the u8→fp32 cast is exact; pinned by tests/test_ingest.py); exists as
+    # the A/B baseline for the bench uint8_ingest_flow scenario and as an
+    # escape hatch if a backend ever mishandles uint8 transfers.
+    float32_wire: bool = False
+    # Device-side resize (resnet50): ship RAW decoded frames and run the
+    # smaller-edge bilinear resize + center crop inside the jitted step
+    # (jax.image.resize) instead of per-frame host PIL — removes the largest
+    # remaining host CPU cost per frame (ROADMAP item 4). NOT bit-identical
+    # to the PIL host path (PIL's uint8 rounding vs XLA's float bilinear —
+    # tolerance pinned in tests/test_ingest.py, documented in
+    # docs/performance.md), so off by default per the ops/image.py parity
+    # contract. Packed runs queue slots per decoded geometry (like i3d);
+    # other feature types print a notice and keep the host path.
+    device_resize: bool = False
     # Dense-flow D2H transfer dtype (raft/pwc extractors): the device casts
     # the flow before the host fetch and the host upcasts back to fp32 (.npy
     # outputs stay fp32). "float16" halves the fetched bytes at ≤0.01 px

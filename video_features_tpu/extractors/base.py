@@ -33,7 +33,7 @@ from ..io.output import (
 )
 from ..io.video import open_video
 from ..parallel import MeshRunner
-from ..parallel.pipeline import DecodePrefetcher
+from ..parallel.pipeline import DecodePrefetcher, HostStagingRing
 from ..parallel.mesh import enable_compilation_cache
 from ..reliability import (
     CircuitBreakerTripped,
@@ -64,6 +64,11 @@ class Extractor(abc.ABC):
     # don't, so the decode pool would prefetch frames nobody reads
     uses_frame_stream = False
 
+    # True for models with a --device_resize path (the host PIL edge resize
+    # moves inside the jitted step); others print a notice and keep the
+    # bit-parity host resize
+    supports_device_resize = False
+
     def __init__(self, cfg: ExtractionConfig):
         cfg = resolve_model_defaults(cfg)
         cfg.validate()
@@ -88,6 +93,20 @@ class Extractor(abc.ABC):
         # pool size the run loops use as their schedule-ahead window
         self._decode_pool: Optional[DecodePrefetcher] = None
         self._decode_workers = max(cfg.decode_workers, 1)
+        # reusable host staging buffers (docs/performance.md "ingest fast
+        # path"): frame-path device batches are assembled into a small
+        # per-geometry ring of preallocated buffers instead of a fresh
+        # np.stack allocation per batch; a buffer is never rewritten while
+        # its device_put is pending, and blocked-on-transfer time lands on
+        # the 'transfer' stage. Depth covers the prefetch pipeline (`depth`
+        # transfers in flight + one being consumed + one being filled).
+        self._staging = HostStagingRing(
+            depth=max(cfg.prefetch_depth, 1) + 2,
+            on_wait=self._transfer_wait)
+        if cfg.device_resize and not type(self).supports_device_resize:
+            print(f"--device_resize ignored: {cfg.feature_type} has no "
+                  "device-side resize path (resnet50 only); keeping the "
+                  "host PIL resize")
         # async output writer; created by run() for save_numpy jobs unless
         # --sync_writer opted out. _pending_writes holds (path, WriteHandle)
         # for extractions whose output is still on the writer thread — on
@@ -185,6 +204,45 @@ class Extractor(abc.ABC):
             return np.asarray(device_out)
         with self.clock.stage("device_wait"):
             return np.asarray(device_out)
+
+    def _transfer_wait(self, seconds: float) -> None:
+        """Staging-ring backpressure (blocked until a pending host→device
+        copy finished) is transfer time — attribute it to that stage."""
+        if self.clock is not None:
+            self.clock.add_seconds("transfer", seconds)
+
+    def _put(self, arr):
+        """Transfer a host batch onto the mesh (sharded along axis 0),
+        attributing host dispatch time and the staged payload bytes to the
+        'transfer' stage — the host→device MB/s counter the run report and
+        the serve stats op derive from."""
+        if self.clock is None:
+            return self.runner.put(arr)
+        with self.clock.stage("transfer"):
+            dev = self.runner.put(arr)
+        self.clock.add_bytes("transfer", int(arr.nbytes))
+        return dev
+
+    def _put_replicated(self, arr):
+        """Replicated transfer with the same 'transfer' attribution. Bytes
+        count the HOST payload once (the replication fan-out across devices
+        rides the interconnect, not the host staging path)."""
+        if self.clock is None:
+            return self.runner.put_replicated(arr)
+        with self.clock.stage("transfer"):
+            dev = self.runner.put_replicated(arr)
+        self.clock.add_bytes("transfer", int(arr.nbytes))
+        return dev
+
+    def _stage_rows(self, rows: Sequence[np.ndarray],
+                    batch_size: Optional[int] = None) -> np.ndarray:
+        """Stack equal-shape host rows into a reusable staging-ring buffer
+        (zero-padded to ``batch_size``) instead of a fresh ``np.stack`` +
+        ``pad_batch`` allocation per batch. The caller must route the staged
+        buffer's device value back through ``self._staging.commit`` (the
+        prefetcher's ``commit`` hook does this) so the buffer is not
+        rewritten while its transfer is pending."""
+        return self._staging.stage(rows, batch_size)
 
     def _throttle(self, outputs: Sequence) -> None:
         """Bound in-flight device work when per-batch results stay on device.
@@ -720,6 +778,9 @@ class Extractor(abc.ABC):
             "video_clips": dict(packer.video_clips),
             "buckets": packer.bucket_stats(),
             "stale_flushes": packer.stale_flushes,
+            # host bytes staged per dispatched device batch (the wire-format
+            # counter the bench's uint8-vs-float32_wire ratio reads)
+            "staged_bytes": packer.staged_bytes,
         }
         if with_metrics:
             dt = time.perf_counter() - t_run
@@ -734,7 +795,8 @@ class Extractor(abc.ABC):
                 starved = decode_starvation_warning(
                     occupancy=packer.occupancy,
                     decode_seconds=self.clock.seconds.get("decode", 0.0),
-                    wall=dt, stale_flushes=packer.stale_flushes)
+                    wall=dt, stale_flushes=packer.stale_flushes,
+                    transfer_seconds=self.clock.seconds.get("transfer", 0.0))
                 if starved:
                     print(starved, file=sys.stderr)
             hits = f", {self._cache.hits} cache hit(s)" if self._cache else ""
@@ -771,7 +833,8 @@ class PackedSession:
         self.ex = ex
         self.spec = spec
         self.packer = CorpusPacker(spec, wait=ex._wait, clock=ex.clock,
-                                   flush_age=ex.cfg.pack_flush_age)
+                                   flush_age=ex.cfg.pack_flush_age,
+                                   staging=ex._staging)
         self._on_done = on_done
         self._on_failed = on_failed
         self._forget = forget_completed

@@ -14,12 +14,19 @@ Behavioral spec (``/root/reference/models/raft/extract_raft.py``,
 TPU design: pairs are batched into one jitted call with a static pair count (the
 tail batch is padded by repeating its last pair, then trimmed), so each video
 geometry compiles exactly once; host decode overlaps device compute through the
-prefetcher. Dense flow is the framework's only D2H-heavy output (full-res
-fp32 maps, not embeddings — ``extract_raft.py:99-101``); the e2e pipeline
-double-buffers the fetch (``copy_to_host_async`` + a bounded pending queue, so
-transfer overlaps both compute and decode) and ``--transfer_dtype float16``
-halves the bytes on the wire (cast on device, upcast on host; outputs stay
-fp32 ``.npy``).
+prefetcher. Frames ride the wire as decoded **uint8** (per-video windows, the
+packed collate chains, and the ``--show_pred`` fallback alike): the u8→fp32
+scale is the jitted step's first fused op — an exact cast, so outputs are
+byte-identical to the retired float32 host staging at a quarter of the
+host→device bytes (``--float32_wire`` restores it as an A/B escape hatch) —
+and windows are assembled into reusable staging-ring buffers
+(:class:`..parallel.pipeline.HostStagingRing`) instead of fresh per-batch
+``np.stack`` allocations. Dense flow is the framework's only D2H-heavy output
+(full-res fp32 maps, not embeddings — ``extract_raft.py:99-101``); the e2e
+pipeline double-buffers the fetch (``copy_to_host_async`` + a bounded pending
+queue, so transfer overlaps both compute and decode) and
+``--transfer_dtype float16`` halves the bytes on the wire (cast on device,
+upcast on host; outputs stay fp32 ``.npy``).
 """
 
 from __future__ import annotations
@@ -35,6 +42,7 @@ import numpy as np
 from ..models.raft import (
     pad_to_multiple,
     pad_to_shape,
+    pad_to_shape_into,
     raft_forward,
     raft_forward_frames,
     raft_forward_frames_sharded,
@@ -75,6 +83,15 @@ class ExtractFlow(Extractor):
         # bit-parity default.
         self._transfer_dtype = {"float32": jnp.float32, "float16": jnp.float16,
                                 "bfloat16": jnp.bfloat16}[cfg.transfer_dtype]
+        # hoisted out of the reap path: the fetched flow needs a host upcast
+        # exactly when a sub-fp32 transfer dtype is configured — decided once
+        # here, not re-inspected per batch (fast-tier output-dtype assertion
+        # in tests/test_ingest.py covers float16/bfloat16)
+        self._upcast = cfg.transfer_dtype != "float32"
+        # H2D wire dtype: decoded uint8 end-to-end (the jitted steps' first
+        # op is the exact u8→fp32 cast); --float32_wire restores the retired
+        # host-side cast at 4× the staged bytes (A/B + bench baseline)
+        self._wire = np.float32 if cfg.float32_wire else np.uint8
         if self.feature_type == "raft":
             self.params = self.runner.put_replicated(
                 resolve_params(
@@ -173,24 +190,75 @@ class ExtractFlow(Extractor):
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.cfg.side_size, self.cfg.resize_to_smaller_edge)
 
-    def _device_call(self, frames: np.ndarray):
+    def _device_call(self, frames: np.ndarray, staged: np.ndarray = None,
+                     timed: bool = True):
         """Dispatch one PADDED (batch_size+1)-frame window to the jitted step.
 
         Single-device meshes run the shared-frame step whole; multi-device
         meshes shard the B source frames on the frame axis and replicate the
         final frame (encode-once everywhere — no mesh size re-encodes
         interior frames). The --precompile warmup calls this with a zeros
-        window so the warmed program is EXACTLY the one real dispatch uses.
+        window of the WIRE dtype so the warmed program is EXACTLY the one
+        real dispatch uses.
+
+        ``staged``: the staging-ring buffer backing ``frames``, committed
+        against the put results so it is never rewritten while the transfer
+        is pending. ``timed=False`` skips the 'transfer' stage attribution —
+        the precompile warmup thread must not race the run loop's StageClock.
         """
+        put = self._put if timed else self.runner.put
+        put_rep = self._put_replicated if timed else self.runner.put_replicated
         if self.runner.num_devices == 1:
-            dev = self.runner.put(np.ascontiguousarray(frames))
+            dev = put(np.ascontiguousarray(frames))
+            if staged is not None:
+                self._staging.commit(staged, dev)
             return self._frames_step(self.params, dev)
-        main = self.runner.put(np.ascontiguousarray(frames[:-1]))
-        last = self.runner.put_replicated(np.ascontiguousarray(frames[-1:]))
+        main = put(np.ascontiguousarray(frames[:-1]))
+        last = put_rep(np.ascontiguousarray(frames[-1:]))
+        if staged is not None:
+            self._staging.commit(staged, (main, last))
         return self._frames_step_sharded(self.params, main, last)
 
+    def _window_geometry(self, h: int, w: int):
+        """Padded (TH, TW) a decoded ``h``×``w`` frame dispatches at — the
+        shape_bucket (or RAFT /8) arithmetic of :meth:`_dispatch_pairs`,
+        shared by the staging-ring window assembly."""
+        m = self.cfg.shape_bucket or (8 if self._pads_input else 1)
+        return -(-h // m) * m, -(-w // m) * m
+
+    def _dispatch_window(self, window):
+        """Stage one decoded frame window into a reusable staging-ring buffer
+        and dispatch it; returns the async handle :meth:`_collect_pairs`
+        materializes.
+
+        The production dispatch path: tail repeat and the geometry pad are
+        written IN PLACE into the ring buffer at the wire dtype (uint8 unless
+        ``--float32_wire``) — no per-batch ``np.stack``/``np.pad``
+        allocations. Byte-identical staging to
+        ``_dispatch_pairs(np.stack(window))``: replicate-padding each frame
+        then repeating the last padded frame equals repeating then padding.
+        """
+        n_pairs = len(window) - 1
+        h, w = window[0].shape[:2]
+        th, tw = self._window_geometry(h, w)
+        buf = self._staging.acquire((self.batch_size + 1, th, tw, 3),
+                                    self._wire)
+        pads = (0, 0, 0, 0)
+        for i, frame in enumerate(window):
+            pads = pad_to_shape_into(frame, buf[i])
+        for i in range(len(window), self.batch_size + 1):
+            buf[i] = buf[len(window) - 1]  # static shape: repeat the tail
+        if not (self.cfg.shape_bucket or self._pads_input):
+            pads = None  # PWC-at-native parity: no unpad slicing
+        flow = self._device_call(buf, staged=buf)
+        self._start_async_copy(flow)
+        return flow, n_pairs, pads
+
     def _dispatch_pairs(self, frames: np.ndarray):
-        """Dispatch one pair window to the device; returns an async handle.
+        """Dispatch one premade pair-window ARRAY to the device; returns an
+        async handle. The compatibility seam for callers holding a stacked
+        window (tests, bench, the dryrun harness) — the production loops
+        stage through :meth:`_dispatch_window` / the packed collate instead.
 
         The jitted call returns immediately (JAX async dispatch) and
         ``copy_to_host_async`` enqueues the D2H transfer right behind the
@@ -240,15 +308,16 @@ class ExtractFlow(Extractor):
         """Materialize a dispatched window → (n_pairs, 2, H, W) fp32 host flow."""
         flow, n_pairs, pads = handle
         flow = self._wait(flow)
-        if flow.dtype != np.float32:  # transfer_dtype cast: upcast on host
-            flow = flow.astype(np.float32)
+        if self._upcast:  # sub-fp32 transfer_dtype: upcast on host (the
+            flow = flow.astype(np.float32)  # decision is hoisted to __init__)
         if pads is not None:
             flow = unpad(flow, pads)
         # NHWC → reference byte layout (B, 2, H, W)
         return flow[:n_pairs].transpose(0, 3, 1, 2)
 
     def _run_pairs(self, frames: np.ndarray) -> np.ndarray:
-        """Flow for all consecutive pairs of (N, H, W, 3) float frames → (N-1, 2, H, W)."""
+        """Flow for all consecutive pairs of (N, H, W, 3) frames (uint8 wire
+        dtype or float) → (N-1, 2, H, W)."""
         return self._collect_pairs(self._dispatch_pairs(frames))
 
     # --- geometry precompile (--precompile) --------------------------------
@@ -263,8 +332,7 @@ class ExtractFlow(Extractor):
                                     self.cfg.resize_to_smaller_edge)
         else:
             w, h = width, height
-        m = self.cfg.shape_bucket or (8 if self._pads_input else 1)
-        return -(-h // m) * m, -(-w // m) * m
+        return self._window_geometry(h, w)
 
     def _start_precompile(self, width: int, height: int) -> None:
         """Warm the jitted step for this video's geometry while decode runs.
@@ -295,9 +363,11 @@ class ExtractFlow(Extractor):
             try:
                 import jax
 
-                window = np.zeros((self.batch_size + 1, h, w, 3), np.float32)
+                # wire dtype (uint8 unless --float32_wire): the warmed
+                # program must be the one the real dispatch uses
+                window = np.zeros((self.batch_size + 1, h, w, 3), self._wire)
                 # host-sync: warmup thread blocks on the zeros window off the critical path by design
-                jax.block_until_ready(self._device_call(window))
+                jax.block_until_ready(self._device_call(window, timed=False))
             except Exception as e:  # noqa: BLE001 — fault-barrier: best-effort warmup; the real dispatch compiles inline and surfaces any genuine error
                 print(f"[flow] geometry precompile ({h}x{w}) failed: "
                       f"{type(e).__name__}: {e}; the first window will "
@@ -375,27 +445,34 @@ class ExtractFlow(Extractor):
             # frame window of `batch` pairs / `batch + 1` frame positions; a
             # chain break costs one extra frame position, and the window tail
             # repeats the last frame exactly like the per-video loop's
-            # partial-batch padding
+            # partial-batch padding. Frames are written straight into a
+            # staging-ring buffer at the wire dtype (uint8 unless
+            # --float32_wire) — no per-batch stack/cast allocation; step()
+            # commits the buffer against its device_put below.
             capacity = batch + 1
-            frames, row_of = [], []
-            n_used, last = 0, None
+            buf = self._staging.acquire((capacity,) + clips[0].shape[1:],
+                                        self._wire)
+            n_frames, n_used, row_of, last = 0, 0, [], None
             for clip, (stream, idx) in zip(clips, stream_keys):
                 chained = last == (stream, idx - 1)
-                if len(frames) + (1 if chained else 2) > capacity:
+                if n_frames + (1 if chained else 2) > capacity:
                     break
                 if not chained:
-                    frames.append(clip[0])
-                frames.append(clip[1])
-                row_of.append(len(frames) - 2)
+                    buf[n_frames] = clip[0]
+                    n_frames += 1
+                buf[n_frames] = clip[1]
+                n_frames += 1
+                row_of.append(n_frames - 2)
                 last = (stream, idx)
                 n_used += 1
-            while len(frames) < capacity:
-                frames.append(frames[-1])
-            return np.stack(frames).astype(np.float32), n_used, row_of
+            while n_frames < capacity:
+                buf[n_frames] = buf[n_frames - 1]
+                n_frames += 1
+            return buf, n_used, row_of
 
         def step(window):
-            out = self._device_call(np.ascontiguousarray(window))
-            # same overlap as the per-video loop's _dispatch_pairs: the
+            out = self._device_call(window, staged=window)
+            # same overlap as the per-video loop's _dispatch_window: the
             # packer fetches this batch only when the bucket's NEXT batch
             # dispatches, so the transfer races compute, not the fetch
             self._start_async_copy(out)
@@ -406,8 +483,8 @@ class ExtractFlow(Extractor):
                 h, w = info["native_hw"]
                 flow = np.zeros((0, 2, h, w), np.float32)
             else:
-                if rows.dtype != np.float32:  # transfer_dtype: upcast on host
-                    rows = rows.astype(np.float32)
+                if self._upcast:  # sub-fp32 transfer_dtype: upcast on host
+                    rows = rows.astype(np.float32)  # (hoisted decision)
                 if any(info["pads"]):
                     rows = unpad(rows, info["pads"])
                 # NHWC rows → reference byte layout (n_pairs, 2, H, W)
@@ -448,10 +525,11 @@ class ExtractFlow(Extractor):
 
         def flush():
             if len(window) > 1:
-                stack = np.stack(window).astype(np.float32)
-                # the frame stack is only needed again for --show_pred
-                pending.append((stack if self.cfg.show_pred else None,
-                                self._dispatch_pairs(stack)))
+                # ring-staged dispatch at the wire dtype; a frame stack is
+                # (re)materialized only for --show_pred's visualizations
+                pending.append((np.stack(window) if self.cfg.show_pred
+                                else None,
+                                self._dispatch_window(window)))
                 while len(pending) > max_pending:
                     collect_one()
 

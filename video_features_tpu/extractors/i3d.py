@@ -334,7 +334,9 @@ class ExtractI3D(Extractor):
             return info, clips()
 
         def step(stacks_u8):
-            dev = self.runner.put(stacks_u8)
+            # _put attributes dispatch time + staged bytes to the 'transfer'
+            # stage; the packer commits the staged buffer after the step
+            dev = self._put(stacks_u8)
             feats = []
             for s in streams:
                 stream_step = self._rgb_step if s == "rgb" else self._flow_step
@@ -361,7 +363,22 @@ class ExtractI3D(Extractor):
         timestamps_ms: List[float] = []
         valid_counts: List[int] = []
 
+        if self._flow_frame_sharded:
+            # this mode forwards (frames, last) VIEW tuples of the batch to
+            # device_put — the ring cannot track views, so a recycled buffer
+            # could be rewritten mid-transfer; keep fresh per-batch arrays
+            # (one single-clip stack per step, a small allocation)
+            def stage(rows, total=None):
+                arr = np.stack(rows)
+                return pad_batch(arr, total) if total is not None else arr
+        else:
+            stage = self._stage_rows
+
         def stack_batches():
+            # clip batches land in reusable staging-ring buffers (uint8 on
+            # the wire; the prefetcher's commit hook guards each buffer
+            # until its device_put resolves) instead of a fresh np.stack +
+            # pad_batch allocation per batch
             stack: List[np.ndarray] = []
             batch: List[np.ndarray] = []
             for rgb, pos in self._timed_frames(frames_iter):
@@ -372,11 +389,11 @@ class ExtractI3D(Extractor):
                     stack = stack[self.step_size :]
                     if len(batch) == self.clips_per_batch:
                         valid_counts.append(len(batch))
-                        yield np.stack(batch)
+                        yield stage(batch)
                         batch = []
             if batch:  # partial clip batch: zero-pad, rows trimmed after the step
                 valid_counts.append(len(batch))
-                yield pad_batch(np.stack(batch), self.clips_per_batch)
+                yield stage(batch, self.clips_per_batch)
             # trailing partial *stack* dropped, as in the reference (:216-219)
 
         if self._flow_frame_sharded:
@@ -398,6 +415,11 @@ class ExtractI3D(Extractor):
                 host_batches(),
                 sharding=sharding,
                 depth=self.cfg.prefetch_depth,
+                clock=self.clock,
+                # commit is a no-op for the frame-sharded mode's view tuples
+                # (their backing ring buffer is guarded per put through the
+                # prefetcher only in standard mode)
+                commit=self._staging.commit,
             )
         ):
             valid = valid_counts[i]

@@ -32,7 +32,7 @@ from ..utils.labels import show_predictions_on_dataset
 from ..utils.windows import form_slices
 from ..weights.convert_torch import convert_r21d
 from ..weights.store import resolve_params
-from .base import Extractor, pad_batch
+from .base import Extractor
 
 
 class ExtractR21D(Extractor):
@@ -103,7 +103,9 @@ class ExtractR21D(Extractor):
             return {}, clips()
 
         def step(clips_u8):
-            return self._step(self.params, self.runner.put(clips_u8))
+            # _put: 'transfer'-stage attribution (time + staged bytes); the
+            # packer commits the staged ring buffer after the step
+            return self._step(self.params, self._put(clips_u8))
 
         def finalize(path, rows, info):
             # reference returns features only for r21d (extract_r21d.py:123-125)
@@ -129,8 +131,11 @@ class ExtractR21D(Extractor):
         vid_feats = []
         for i in range(0, len(slices), self.clips_per_batch):
             chunk = slices[i : i + self.clips_per_batch]
-            clips = np.stack([frames[s:e] for s, e in chunk])
-            clips = self.runner.put(pad_batch(clips, self.clips_per_batch))
+            clips = self._stage_rows([frames[s:e] for s, e in chunk],
+                                     self.clips_per_batch)
+            dev = self._put(clips)
+            self._staging.commit(clips, dev)  # guard the ring buffer
+            clips = dev
             # stays on device; one host fetch per video
             feats = self._step(self.params, clips)[: len(chunk)]
             if self.cfg.show_pred:  # debug mode: fetch once, reuse for logits

@@ -9,7 +9,10 @@ head (``:54-58,98-101``).
 
 TPU design: host does decode+resize+crop (uint8); the jitted device step fuses
 normalize into the conv stack; the tail batch is zero-padded to the static batch
-shape so XLA compiles exactly one program per run.
+shape so XLA compiles exactly one program per run. ``--device_resize`` moves the
+PIL resize+crop inside the step too (``ops/image.device_resize_crop_hwc``): raw
+decoded frames ride the wire, one compiled program per decoded geometry, at a
+documented tolerance vs the PIL parity path (docs/performance.md).
 """
 
 from __future__ import annotations
@@ -23,11 +26,11 @@ import jax.numpy as jnp
 
 from ..models.resnet import ResNet50, preprocess_frames
 from ..parallel import prefetch_to_device
-from ..ops.image import np_center_crop_hwc, pil_edge_resize
+from ..ops.image import device_resize_crop_hwc, np_center_crop_hwc, pil_edge_resize
 from ..utils.labels import show_predictions_on_dataset
 from ..weights.convert_torch import convert_resnet50
 from ..weights.store import resolve_params
-from .base import Extractor, pad_batch
+from .base import Extractor
 
 RESIZE_SIZE = 256
 CENTER_CROP_SIZE = 224
@@ -35,9 +38,15 @@ CENTER_CROP_SIZE = 224
 
 class ExtractResNet50(Extractor):
     uses_frame_stream = True
+    # --device_resize: the host PIL resize+crop moves inside the jitted step
+    # (ops/image.device_resize_crop_hwc) — raw decoded frames on the wire,
+    # slots keyed per decoded geometry in packed runs; tolerance-gated vs
+    # the bit-parity host path (docs/performance.md)
+    supports_device_resize = True
 
     def __init__(self, cfg):
         super().__init__(cfg)
+        self._device_resize = cfg.device_resize
         # round the user batch up to a multiple of the mesh size so the sharded
         # leading axis always divides evenly (tail rows are zero-padded + trimmed)
         self.batch_size = self.runner.device_batch(cfg.batch_size)
@@ -66,11 +75,19 @@ class ExtractResNet50(Extractor):
         return random_params_like(init, rng, dummy)["params"]
 
     def _forward(self, params, frames_u8):
+        if self._device_resize:
+            # raw decoded frames in: the edge resize + crop run fused into
+            # the step (static geometry per compile — each decoded geometry
+            # is its own program, like the i3d aspect-ratio queues)
+            frames_u8 = device_resize_crop_hwc(
+                frames_u8, RESIZE_SIZE, CENTER_CROP_SIZE)
         x = preprocess_frames(frames_u8, dtype=self.dtype)
         feats = self.model.apply({"params": params}, x, features=True)
         return feats.astype(jnp.float32)
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
+        if self._device_resize:
+            return rgb  # ship the raw decoded frame; the step resizes
         rgb = pil_edge_resize(rgb, RESIZE_SIZE)
         return np_center_crop_hwc(rgb, CENTER_CROP_SIZE, CENTER_CROP_SIZE)
 
@@ -97,7 +114,9 @@ class ExtractResNet50(Extractor):
             return info, clips()
 
         def step(frames_u8):
-            return self._step(self.params, self.runner.put(frames_u8))
+            # _put attributes dispatch time + staged bytes to the 'transfer'
+            # stage; the packer commits the staged buffer after the step
+            return self._step(self.params, self._put(frames_u8))
 
         def finalize(path, rows, info):
             return {
@@ -115,17 +134,20 @@ class ExtractResNet50(Extractor):
         valid_counts = []
 
         def batches():
+            # frames are stacked into reusable staging-ring buffers (the
+            # prefetcher's commit hook guards them until their device_put
+            # resolves) — no fresh np.stack/pad_batch allocation per batch
             batch = []
             for rgb, pos in self._timed_frames(frames):
                 timestamps_ms.append(pos)
                 batch.append(rgb)
                 if len(batch) == self.batch_size:
                     valid_counts.append(len(batch))
-                    yield np.stack(batch)
+                    yield self._stage_rows(batch)
                     batch = []
-            if batch:  # partial tail batch (reference :139-141)
+            if batch:  # partial tail batch (reference :139-141), zero-padded
                 valid_counts.append(len(batch))
-                yield pad_batch(np.stack(batch), self.batch_size)
+                yield self._stage_rows(batch, self.batch_size)
 
         if self.cfg.show_pred:
             # debug path: fetch the fc head ONCE per video (device_wait-
@@ -145,6 +167,8 @@ class ExtractResNet50(Extractor):
                 batches(),
                 sharding=self.runner.batch_sharding,
                 depth=self.cfg.prefetch_depth,
+                clock=self.clock,
+                commit=self._staging.commit,
             )
         ):
             feats = self._step(self.params, device_batch)[: valid_counts[i]]

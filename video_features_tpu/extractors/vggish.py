@@ -109,7 +109,9 @@ class ExtractVGGish(Extractor):
             return {}, clips()
 
         def step(examples):
-            return self._step(self.params, self.runner.put(examples))
+            # _put: 'transfer'-stage attribution (time + staged bytes); the
+            # packer commits the staged ring buffer after the step
+            return self._step(self.params, self._put(examples))
 
         def finalize(path, rows, info):
             if self.postprocessor is not None:
@@ -133,7 +135,7 @@ class ExtractVGGish(Extractor):
             for i in range(0, len(examples), self.example_batch):
                 chunk = examples[i : i + self.example_batch]
                 valid = len(chunk)
-                batch = self.runner.put(pad_batch(chunk, self.example_batch))
+                batch = self._put(pad_batch(chunk, self.example_batch))
                 # stays on device; one host fetch per video
                 feats.append(self._step(self.params, batch)[:valid])
                 self._throttle(feats)

@@ -127,8 +127,10 @@ def _grid64(h: int, w: int) -> Tuple[int, int]:
 def pwc_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
                 corr_impl: str = "xla", dtype=jnp.float32,
                 warp_impl: str = "auto") -> jnp.ndarray:
-    """Flow frame1→frame2. Inputs (B, H, W, 3) float RGB [0, 255], any size.
-    Returns (B, H, W, 2) float32 flow in input-resolution pixels.
+    """Flow frame1→frame2. Inputs (B, H, W, 3) RGB [0, 255] — uint8 (the
+    extractors' wire format; ``_preprocess``'s fp32 cast is the first traced
+    op, exact) or float — any size. Returns (B, H, W, 2) float32 flow in
+    input-resolution pixels.
 
     ``corr_impl``: cost-volume implementation (``xla`` | ``pallas``), see
     :mod:`video_features_tpu.ops.pallas_corr`. ``dtype``: conv compute dtype —

@@ -440,7 +440,9 @@ def raft_forward(params: Dict, image1: jnp.ndarray, image2: jnp.ndarray,
                  iters: int = ITERS, taps: Dict = None,
                  corr_impl: str = "volume", dtype=jnp.float32,
                  n_devices: int = 1) -> jnp.ndarray:
-    """Flow from frame1 to frame2. Inputs (B, H, W, 3) float RGB in [0, 255],
+    """Flow from frame1 to frame2. Inputs (B, H, W, 3) RGB in [0, 255] —
+    uint8 (the extractors' wire format: the u8→fp32 cast below is the first
+    traced op, exact, so host staging ships quarter the bytes) or float32 —
     H and W divisible by 8. Returns (B, H, W, 2) flow in pixels (u, v).
 
     ``corr_impl``: ``volume`` materializes the all-pairs pyramid (reference
@@ -490,7 +492,8 @@ def raft_forward_frames(params: Dict, frames: jnp.ndarray, iters: int = ITERS,
     """Flow for all consecutive frame pairs, sharing per-frame features.
 
     ``frames``: (F, H, W, 3) → (F−1, H, W, 2), or a clip batch (N, F, H, W, 3)
-    → (N, F−1, H, W, 2) — pairs never cross clip boundaries.
+    → (N, F−1, H, W, 2) — pairs never cross clip boundaries. uint8 or float
+    RGB in [0, 255] (uint8 is the wire format; the fp32 cast is traced).
 
     TPU-first formulation of the reference's pair loop: ``fnet`` runs ONCE per
     frame (clips flattened into the conv batch axis) and pairs are formed by
@@ -653,8 +656,9 @@ def pad_to_shape(frames: np.ndarray, target_hw: Tuple[int, int],
     Same centered sintel split as :func:`pad_to_multiple` — when the target
     is the geometry's own /8 (or ``--shape_bucket``) padding, the result is
     byte-identical to the per-video path's pad, which is what the packed
-    flow loop's byte-parity contract rides on. Returns (padded, pads) for
-    :func:`unpad`.
+    flow loop's byte-parity contract rides on. Dtype-preserving: uint8
+    frames pad to uint8 (the wire format — the u8→fp32 cast lives inside
+    the jitted step, not here). Returns (padded, pads) for :func:`unpad`.
     """
     th, tw = target_hw
     h, w = frames.shape[-3:-1]
@@ -667,6 +671,39 @@ def pad_to_shape(frames: np.ndarray, target_hw: Tuple[int, int],
     left, right = pw // 2, pw - pw // 2
     pad = [(0, 0)] * (frames.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
     return np.pad(frames, pad, mode="edge"), (top, bottom, left, right)
+
+
+def pad_to_shape_into(frame: np.ndarray, out: np.ndarray,
+                      ) -> Tuple[int, int, int, int]:
+    """:func:`pad_to_shape` into a PREALLOCATED ``(TH, TW, C)`` buffer.
+
+    The staging-ring fast path: one (H, W, C) decoded frame is written
+    replicate-padded straight into its row of a reusable device-batch buffer
+    — no intermediate ``np.pad`` allocation per frame, and the dtype follows
+    ``out`` (uint8 stays uint8; a float32 ring under ``--float32_wire``
+    upcasts exactly). Byte-identical to ``pad_to_shape(frame, out.shape[:2])``
+    — fill the center, replicate the side columns across the frame's rows,
+    then replicate whole padded rows outward (corners land on the frame's
+    corner texels, ``np.pad(mode="edge")`` semantics). Returns the same pads
+    tuple for :func:`unpad`.
+    """
+    th, tw = out.shape[0], out.shape[1]
+    h, w = frame.shape[0], frame.shape[1]
+    if th < h or tw < w:
+        raise ValueError(f"cannot pad {h}x{w} frames down to bucket {th}x{tw}")
+    ph, pw = th - h, tw - w
+    top, bottom = ph // 2, ph - ph // 2
+    left, right = pw // 2, pw - pw // 2
+    out[top : th - bottom, left : tw - right] = frame
+    if left:
+        out[top : th - bottom, :left] = frame[:, :1]
+    if right:
+        out[top : th - bottom, tw - right :] = frame[:, -1:]
+    if top:
+        out[:top] = out[top : top + 1]
+    if bottom:
+        out[th - bottom :] = out[th - bottom - 1 : th - bottom]
+    return (top, bottom, left, right)
 
 
 def unpad(x: np.ndarray, pads: Tuple[int, int, int, int]) -> np.ndarray:

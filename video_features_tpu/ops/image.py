@@ -7,7 +7,10 @@ support, so for bit-parity the aspect-preserving edge resize stays on the host (
 uint8 is exactly what the reference computes); everything after — center crop, scaling
 to [-1,1], flow quantization — is pure elementwise math and runs on device inside the
 jitted forward (:mod:`video_features_tpu.extractors`), where XLA fuses it into the
-first conv.
+first conv. ``--device_resize`` (resnet50) opts the edge resize itself onto the
+device too (:func:`device_resize_crop_hwc`) — raw decoded frames on the wire, the
+whole preprocess fused into the step — trading that bit-parity contract for ingest
+throughput at a tolerance pinned in tests/test_ingest.py.
 """
 
 from __future__ import annotations
@@ -53,6 +56,35 @@ def pil_edge_resize(
     if (ow, oh) == (w, h):
         return rgb_hwc
     return np.asarray(Image.fromarray(rgb_hwc).resize((ow, oh), Image.BILINEAR))
+
+
+def device_resize_crop_hwc(x: jnp.ndarray, size: int, crop: int,
+                           to_smaller_edge: bool = True) -> jnp.ndarray:
+    """Traced edge resize + round-half center crop for NHWC frames — the
+    ``--device_resize`` fast path (docs/performance.md "ingest fast path").
+
+    The host ships RAW decoded uint8 frames and this runs INSIDE the jitted
+    step: ``jax.image.resize`` bilinear (antialiased on downscale) to the
+    same target the reference's PIL resize computes (``edge_resize_size``
+    arithmetic, static at trace time), then the torchvision round-half
+    center crop. NOT bit-identical to :func:`pil_edge_resize` — PIL
+    interpolates in uint8 with its own filter support and rounding, XLA in
+    float — which is exactly why the module contract above keeps the host
+    path as the parity default; the drift is tolerance-pinned in
+    tests/test_ingest.py and documented in docs/performance.md. Returns
+    float32 frames in [0, 255] (N, crop, crop, C).
+    """
+    import jax
+
+    h, w = int(x.shape[-3]), int(x.shape[-2])
+    ow, oh = edge_resize_size(w, h, size, to_smaller_edge)
+    y = x.astype(jnp.float32)
+    if (ow, oh) != (w, h):
+        y = jax.image.resize(
+            y, x.shape[:-3] + (oh, ow, x.shape[-1]), method="bilinear")
+    i = int(round((oh - crop) / 2.0))
+    j = int(round((ow - crop) / 2.0))
+    return y[..., i : i + crop, j : j + crop, :]
 
 
 def center_crop(x: jnp.ndarray, crop_size: int) -> jnp.ndarray:

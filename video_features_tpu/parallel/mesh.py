@@ -64,8 +64,18 @@ def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
     round their batch size up via :meth:`MeshRunner.device_batch` and zero-pad the
     tail (:func:`video_features_tpu.extractors.base.pad_batch`). Output shardings
     are left to XLA (batch-preserving steps keep rows sharded; ``np.asarray``
-    gathers them to host). Inputs are not donated: the uint8→float first op can't
-    reuse the input buffer anyway (XLA donation warning observed in round 1).
+    gathers them to host).
+
+    Inputs are not donated — not because of the cast per se, but because XLA
+    input-output aliasing needs an output of IDENTICAL shape/dtype/layout to
+    reuse a donated buffer, and with the uint8 wire format no frame-path step
+    has one: every step consumes a uint8 frame buffer (4× smaller than any
+    float activation or output) and emits fp32 (or ``--transfer_dtype``)
+    features/flow, so donation would only emit XLA's "donated buffer could
+    not be aliased" warning per compile. If a step with a genuinely matching
+    output ever lands (e.g. an fp16-in/fp16-out path), thread
+    ``donate_argnums`` through to ``jax.jit`` here — with a test that pins
+    the aliasing actually happening.
 
     ``matmul_precision``: TPU fp32 convs/matmuls default to bf16 MXU passes;
     ``"highest"`` traces the step under true-fp32 accumulation for the

@@ -216,11 +216,18 @@ class CorpusPacker:
     """
 
     def __init__(self, spec: PackSpec, wait: Callable[[Any], np.ndarray],
-                 clock=None, flush_age: int = 0):
+                 clock=None, flush_age: int = 0, staging=None):
         self._spec = spec
         self._wait = wait
         self._clock = clock  # optional StageClock: packed_slots/packed_clips units
         self._flush_age = flush_age
+        # optional HostStagingRing: the default (no-collate) batch assembly
+        # fills a reusable per-geometry buffer instead of np.stack+pad_batch
+        # allocating per dispatch; the buffer is committed against the step's
+        # device output (output ready ⟹ the input transfer was consumed), so
+        # it is never rewritten while the device may still read it. Collate
+        # specs (flow) stage into the ring themselves.
+        self._staging = staging
         self._pending: Dict[tuple, List[_Slot]] = {}
         self._open: Dict[str, FeatureAssembly] = {}
         self._finished: List[FeatureAssembly] = []
@@ -232,6 +239,7 @@ class CorpusPacker:
         self._videos_finished = 0
         self.real_slots = 0  # clips dispatched
         self.dispatched_slots = 0  # clips + padding/boundary slots dispatched
+        self.staged_bytes = 0  # host bytes staged per dispatched device batch
         self.video_clips: Dict[str, int] = {}  # per finished video
         # per shape key: {"real_slots", "dispatched_slots", "stale_flushes"}
         self._bucket_stats: Dict[tuple, Dict[str, int]] = {}
@@ -307,8 +315,6 @@ class CorpusPacker:
     # --- dispatch ------------------------------------------------------------
 
     def _dispatch(self, key: tuple) -> None:
-        from ..extractors.base import pad_batch  # runtime: avoids an import cycle
-
         queue = self._pending[key]
         batch_size = self._spec.batch_size
         candidates = queue[:batch_size]
@@ -321,10 +327,15 @@ class CorpusPacker:
         else:
             slots = candidates
             del queue[:batch_size]
-            batch = pad_batch(np.stack([s.clip for s in slots]), batch_size)
+            batch = self._stage_batch([s.clip for s in slots], batch_size)
             row_of = range(len(slots))
         self._scatter_inflight(key)  # resolve this bucket's batch k first
         out = self._spec.step(batch)
+        if self._staging is not None:
+            # no-op for batches the ring does not own (collate specs commit
+            # their own buffers at device_put time, inside step)
+            self._staging.commit(batch, out)
+        self.staged_bytes += int(getattr(batch, "nbytes", 0))
         self._inflight[key] = (slots, row_of, out)
         # a bucket being served is not starving: age counts from its last
         # activity (dispatch here, slot arrival in add())
@@ -338,6 +349,18 @@ class CorpusPacker:
         if self._clock is not None:
             self._clock.add_units("packed_slots", batch_size)
             self._clock.add_units("packed_clips", len(slots))
+
+    def _stage_batch(self, clips: List[np.ndarray],
+                     batch_size: int) -> np.ndarray:
+        """Default batch assembly: clips stacked (zero-padded to the static
+        batch shape) into a reusable staging-ring buffer when a ring is
+        wired, else the original fresh ``np.stack`` + ``pad_batch``. Dtype
+        follows the clips — uint8 frame slots stay uint8 on the wire."""
+        from ..extractors.base import pad_batch  # runtime: avoids an import cycle
+
+        if self._staging is None:
+            return pad_batch(np.stack(clips), batch_size)
+        return self._staging.stage(clips, batch_size)
 
     def _scatter_inflight(self, key: Optional[tuple] = None) -> None:
         keys = [key] if key is not None else list(self._inflight)

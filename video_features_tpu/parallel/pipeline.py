@@ -318,10 +318,155 @@ class DecodePrefetcher:
         self._handed.clear()
 
 
+class HostStagingRing:
+    """Reusable host staging buffers for ``device_put`` sources.
+
+    Every frame-path device batch used to be assembled into a FRESH
+    ``np.stack(...)`` (historically ``.astype(np.float32)``) allocation —
+    per-batch host memory churn on exactly the hot path where the uint8 wire
+    format just quartered the bytes. The ring hands out a small per-geometry
+    set of preallocated buffers instead: callers :meth:`acquire` a
+    ``(shape, dtype)`` buffer, fill it in place, ``device_put`` it, and
+    :meth:`commit` it back with the resulting device value.
+
+    Discipline (the ``AsyncOutputWriter``'s bounded-ring idea applied to H2D
+    staging): a buffer is never rewritten while its ``device_put`` may still
+    be reading it. JAX transfers are asynchronous — the sharded CPU path
+    copies lazily and TPU DMA reads the host buffer after dispatch returns —
+    so :meth:`acquire` blocks on the committed device value's
+    ``block_until_ready`` before handing the same buffer out again. That wait
+    is the transfer pipe's backpressure and is surfaced through ``on_wait``
+    (the extractors attribute it to the 'transfer' stage).
+
+    Single-threaded by design: acquire/fill/commit all run on the run-loop
+    thread (like the corpus packer), so the ring needs no locks and vftlint's
+    thread-shared-state table gains no entries. Slots never leave their ring
+    — a dispatch failure between acquire and commit just leaves the slot's
+    previous (already-awaited) device value cleared, so error paths cannot
+    leak buffers.
+
+    Memory bound: at most ``max_geometries`` per-geometry rings are kept —
+    acquiring a new geometry past the cap evicts the least-recently-acquired
+    ring (its pending transfers awaited first), so a long-lived caller (the
+    ``--serve`` daemon staging an open-ended mix of video geometries, or
+    ``--device_resize`` shipping native-resolution frames) holds at most
+    ``max_geometries × depth`` buffers instead of growing forever — the ring
+    analogue of ``packer.forget``'s long-run bound. A corpus cycling through
+    more concurrent geometries than the cap just re-allocates for the
+    evicted ones (correctness unaffected).
+    """
+
+    def __init__(self, depth: int = 3, on_wait: Optional[Callable] = None,
+                 max_geometries: int = 8):
+        if depth < 1:
+            raise ValueError("staging ring depth must be >= 1")
+        if max_geometries < 1:
+            raise ValueError("staging ring max_geometries must be >= 1")
+        self._depth = depth
+        self._on_wait = on_wait
+        self._max_geometries = max_geometries
+        # (shape, dtype-str) -> deque of {"buf", "dev"} slots, oldest first
+        self._rings: dict = {}
+        self._last_acquire: dict = {}  # key -> tick of last acquire (LRU)
+        self._tick = 0
+        self.allocated = 0  # buffers ever allocated (reuse observability)
+        self.acquires = 0
+        self.evicted_geometries = 0
+        self.wait_seconds = 0.0  # cumulative blocked-on-transfer time
+
+    @staticmethod
+    def _key(shape, dtype) -> tuple:
+        return (tuple(shape), np.dtype(dtype).str)
+
+    def _await(self, slot: dict) -> None:
+        """Block until the slot's committed transfer finished (accounted)."""
+        if slot["dev"] is None:
+            return
+        t0 = time.perf_counter()
+        for leaf in jax.tree_util.tree_leaves(slot["dev"]):
+            ready = getattr(leaf, "block_until_ready", None)
+            if ready is not None:
+                ready()
+        waited = time.perf_counter() - t0
+        self.wait_seconds += waited
+        if self._on_wait is not None:
+            self._on_wait(waited)
+        slot["dev"] = None
+
+    def _evict_lru_geometry(self) -> None:
+        key = min(self._rings, key=lambda k: self._last_acquire.get(k, 0))
+        for slot in self._rings.pop(key):
+            # a pending lazy copy may still read the buffer we are about to
+            # drop our last reference to — await it before freeing
+            self._await(slot)
+        self._last_acquire.pop(key, None)
+        self.evicted_geometries += 1
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        """A writable staging buffer of ``(shape, dtype)``.
+
+        Allocates until the ring holds ``depth`` buffers for this geometry,
+        then recycles the least-recently-acquired one — blocking first until
+        its committed transfer has completed (never rewrite a buffer a
+        pending ``device_put`` may still read).
+        """
+        key = self._key(shape, dtype)
+        if key not in self._rings and len(self._rings) >= self._max_geometries:
+            self._evict_lru_geometry()  # long-run bound: ≤ cap geometries
+        ring = self._rings.setdefault(key, collections.deque())
+        self.acquires += 1
+        self._tick += 1
+        self._last_acquire[key] = self._tick
+        if len(ring) < self._depth:
+            slot = {"buf": np.empty(shape, dtype), "dev": None}
+            self.allocated += 1
+        else:
+            slot = ring.popleft()
+            self._await(slot)
+        ring.append(slot)  # stays in the ring: error paths cannot leak it
+        return slot["buf"]
+
+    def stage(self, rows, total: Optional[int] = None) -> np.ndarray:
+        """Stack equal-shape host ``rows`` into an acquired buffer, zero-
+        padded to ``total`` leading entries (default ``len(rows)``) — the one
+        shared fill discipline for every batch-staging caller
+        (``Extractor._stage_rows``, the packer's default batch assembly).
+        Dtype follows the rows: uint8 frames stay uint8 on the wire."""
+        n = len(rows)
+        if total is None:
+            total = n
+        buf = self.acquire((total,) + rows[0].shape, rows[0].dtype)
+        for i, row in enumerate(rows):
+            buf[i] = row
+        if n < total:
+            buf[n:] = 0
+        return buf
+
+    def commit(self, buf: np.ndarray, device_value) -> None:
+        """Record ``device_value`` (a jax array or pytree of them) as the
+        in-flight transfer reading ``buf``; the slot is not recycled until it
+        is ready. A ``buf`` the ring does not own is a no-op — callers may
+        pass every dispatched batch through here without tracking which ones
+        were ring-staged (e.g. a zero-padded tail batch from ``pad_batch``,
+        or the frame-sharded I3D path's (frames, last) view tuples).
+        """
+        if not isinstance(buf, np.ndarray):
+            return
+        ring = self._rings.get(self._key(buf.shape, buf.dtype))
+        if ring is None:
+            return
+        for slot in ring:
+            if slot["buf"] is buf:
+                slot["dev"] = device_value
+                return
+
+
 def prefetch_to_device(
     arrays: Iterable[np.ndarray],
     sharding=None,
     depth: int = 2,
+    clock=None,
+    commit: Optional[Callable] = None,
 ) -> Iterator[jax.Array]:
     """Iterate device arrays with ``depth`` transfers in flight.
 
@@ -330,18 +475,37 @@ def prefetch_to_device(
     (e.g. the frame-sharded I3D flow step's (frames, last_frame) pairs) with
     ``sharding`` a matching pytree of shardings — ``jax.device_put`` accepts
     both.
+
+    ``clock``: optional :class:`..utils.metrics.StageClock` — the put
+    dispatch time and the staged payload bytes land on the 'transfer' stage.
+    ``commit(host, dev)``: optional hook called right after each put — the
+    extractors pass :meth:`HostStagingRing.commit` so ring-staged batches are
+    guarded against rewrite until their transfer completes.
     """
     if depth < 1:
         raise ValueError("prefetch depth must be >= 1")
     queue: collections.deque = collections.deque()
     it = iter(arrays)
 
+    def put(host):
+        if clock is None:
+            return jax.device_put(host, sharding)
+        with clock.stage("transfer"):
+            dev = jax.device_put(host, sharding)
+        clock.add_bytes("transfer", sum(
+            int(getattr(leaf, "nbytes", 0))
+            for leaf in jax.tree_util.tree_leaves(host)))
+        return dev
+
     def enqueue() -> bool:
         try:
             host = next(it)
         except StopIteration:
             return False
-        queue.append(jax.device_put(host, sharding))
+        dev = put(host)
+        queue.append(dev)
+        if commit is not None:
+            commit(host, dev)
         return True
 
     for _ in range(depth):

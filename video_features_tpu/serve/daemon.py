@@ -489,6 +489,23 @@ class ExtractionService:
                 return {"ok": False, "error": f"unreadable result: {e}"}
         return {"ok": False, "error": f"unknown request_id {request_id!r}"}
 
+    def _transfer_stats(self) -> dict:
+        """Host→device staging counters from the service-lifetime clock plus
+        the staging ring's reuse/backpressure accounting."""
+        clock = self.ex.clock
+        seconds = clock.seconds.get("transfer", 0.0) if clock else 0.0
+        nbytes = clock.bytes.get("transfer", 0) if clock else 0
+        ring = self.ex._staging
+        return {
+            "seconds": round(seconds, 3),
+            "bytes": int(nbytes),
+            "mb_per_s": round(nbytes / seconds / 1e6, 2) if seconds else 0.0,
+            "staging_buffers": ring.allocated,
+            "staging_acquires": ring.acquires,
+            "staging_evicted_geometries": ring.evicted_geometries,
+            "staging_wait_sec": round(ring.wait_seconds, 3),
+        }
+
     def stats(self) -> dict:
         pool = self.ex._decode_pool
         with self._lock:
@@ -511,6 +528,11 @@ class ExtractionService:
                     "buckets": self.packer.bucket_stats(),
                     "stale_flushes": self.packer.stale_flushes,
                 },
+                # host→device staging health (ingest fast path): operators
+                # can tell a transfer-bound daemon from a decode-bound one
+                # without tailing the log (seconds/bytes are defaultdict
+                # .get reads — atomic enough against the daemon thread)
+                "transfer": self._transfer_stats(),
                 "cache": (dict(self.ex._cache.stats(),
                                coalesced=self._coalescer.coalesced,
                                waiting=self._coalescer.waiting())

@@ -11,6 +11,12 @@ Stage semantics (async device dispatch makes naive timing lie):
   iterator — real decode-bound time.
 - ``device_wait``: host time blocked on device results (``np.asarray`` /
   ``block_until_ready``) — compute-bound time NOT hidden by prefetch.
+- ``transfer``: host time staging batches onto the mesh (``device_put``
+  dispatch plus any staging-ring wait for a pending host→device copy to
+  finish before its buffer is rewritten), with the staged payload bytes
+  attached — the report derives host→device MB/s from them, so a run can be
+  told apart as decode-bound vs transfer-bound (docs/performance.md ingest
+  fast path).
 - ``wall``: end-to-end per video. ``wall − decode − device_wait`` ≈ host
   stacking/bookkeeping overlapped with device work.
 """
@@ -35,30 +41,46 @@ def metrics_enabled(profile_dir=None) -> bool:
 # "look at --decode_workers" nudge, not an SLO.
 STARVED_OCCUPANCY = 0.8
 STARVED_DECODE_FRACTION = 0.4
+STARVED_TRANSFER_FRACTION = 0.4
 
 
 def decode_starvation_warning(occupancy: float, decode_seconds: float,
                               wall: float, stale_flushes: int = 0,
+                              transfer_seconds: float = 0.0,
                               ) -> Optional[str]:
-    """Message when a packed run's padding is decode-starvation, else None.
+    """Message when a packed run's padding is decode- (or transfer-)
+    starvation, else None.
 
     ``occupancy``: real clips / dispatched device slots for the whole corpus.
     ``decode_seconds``: host time blocked on the frame stream ('decode' stage).
     ``wall``: packed-run wall-clock. ``stale_flushes``: anti-starvation
     flushes taken (each one trades padding for latency, so a high count with
     low occupancy strengthens the signal — it is reported, not gated on).
+    ``transfer_seconds``: host time blocked staging batches onto the mesh
+    ('transfer' stage) — when the padding is burned waiting on the host→device
+    pipe rather than on decode, raising --decode_workers would do nothing, so
+    the message names the right lever instead.
     """
     if wall <= 0 or occupancy >= STARVED_OCCUPANCY:
         return None
     decode_fraction = decode_seconds / wall
-    if decode_fraction < STARVED_DECODE_FRACTION:
-        return None
-    return (f"warning: packing occupancy {occupancy:.1%} with "
-            f"{decode_fraction:.0%} of wall blocked on decode"
-            + (f" and {stale_flushes} anti-starvation flush(es)"
+    flushes = (f" and {stale_flushes} anti-starvation flush(es)"
                if stale_flushes else "")
-            + " — the decode pool is starving the mesh; raise "
-            "--decode_workers (docs/performance.md)")
+    if decode_fraction >= STARVED_DECODE_FRACTION:
+        return (f"warning: packing occupancy {occupancy:.1%} with "
+                f"{decode_fraction:.0%} of wall blocked on decode"
+                + flushes
+                + " — the decode pool is starving the mesh; raise "
+                "--decode_workers (docs/performance.md)")
+    transfer_fraction = transfer_seconds / wall
+    if transfer_fraction >= STARVED_TRANSFER_FRACTION:
+        return (f"warning: packing occupancy {occupancy:.1%} with "
+                f"{transfer_fraction:.0%} of wall blocked on host→device "
+                "transfer" + flushes
+                + " — the transfer pipe, not decode, is starving the mesh; "
+                "check the transfer-stage MB/s and drop --float32_wire if "
+                "set (docs/performance.md)")
+    return None
 
 
 class StageClock:
@@ -78,6 +100,16 @@ class StageClock:
     def add_units(self, name: str, n: int = 1) -> None:
         """Accumulate a dimensionless counter reported alongside the stages."""
         self.units[name] += n
+
+    def add_seconds(self, name: str, seconds: float) -> None:
+        """Attribute externally-measured blocked time to a stage (e.g. the
+        staging ring's wait for a pending host→device copy)."""
+        self.seconds[name] += seconds
+
+    def add_bytes(self, name: str, n: int) -> None:
+        """Attribute payload bytes to a stage measured via :meth:`stage`
+        (timed_iter's ``bytes_of`` does this for iterator stages)."""
+        self.bytes[name] += n
 
     @contextlib.contextmanager
     def stage(self, name: str):
