@@ -1072,6 +1072,157 @@ def main() -> None:
                  f"occupancy {entry['packing_occupancy']} (one-batch-run "
                  f"baseline {entry['batch_occupancy_baseline']})")
 
+    # ---- co-resident models on one mesh (--serve_models) ----------------------
+    # Mixed two-model traffic through ONE daemon vs each model's single-model
+    # daemon serving its half of the corpus at the same per-model request
+    # rate: a single-model daemon idle-pad-flushes its partial queues
+    # whenever its own traffic lulls (the mesh drains between its requests),
+    # while the two-model daemon keeps the queue non-idle because the other
+    # model's requests fill the gaps — so aggregate packed occupancy on
+    # mixed traffic should beat what either single-model daemon achieves on
+    # its half. Per-model occupancy comes from the shared packer's
+    # (model, geometry) buckets (docs/serving.md). Stale-record protocol
+    # unchanged: rides guarded()/clear_failure like every scenario.
+    if not over_budget("multi_model_service"):
+        with guarded("multi_model_service"):
+            import threading as _threading
+
+            from video_features_tpu.serve import ExtractionService
+
+            n_per_model = 6 if on_cpu else 12
+            per_request = 2
+            batch = 4 if on_cpu else 32
+            # frame counts chosen to never divide the batch: every request
+            # tails a partial queue an idle daemon would pad-flush
+            corpus_a = write_corpus(
+                "mm_resnet",
+                [((64, 48), 3 + (i % 3)) for i in range(n_per_model)])
+            corpus_b = write_corpus(
+                "mm_r21d",
+                [((64, 48), 17 + 2 * (i % 2)) for i in range(n_per_model)])
+            # the timing triangle that makes the comparison meaningful:
+            # idle_flush must EXCEED the mixed daemon's idle window
+            # (stagger − processing) so interleaved traffic keeps partials
+            # alive, and FALL SHORT of the single daemons' window
+            # (2·stagger − processing) so a single-model daemon's lulls
+            # pad-flush — the drain the mixed mesh no longer pays
+            stagger = 0.5 if on_cpu else 0.25
+            idle_flush = 0.4 if on_cpu else 0.15
+
+            def mm_cfg(sub, feature="resnet50", **kw):
+                spool = os.path.join("/tmp/vft_bench", sub, "spool")
+                os.makedirs(spool, exist_ok=True)
+                return ExtractionConfig(
+                    feature_type=feature, batch_size=batch, serve=True,
+                    clips_per_batch=batch,  # r21d packs by clips_per_batch
+                    on_extraction="save_numpy", spool_dir=spool,
+                    idle_flush_sec=idle_flush,
+                    compilation_cache=os.path.join("/tmp/vft_bench",
+                                                   "xla_cache"),
+                    output_path=os.path.join("/tmp/vft_bench", sub),
+                    tmp_path=os.path.join("/tmp/vft_bench", "tmp"), **kw)
+
+            def run_daemon(sub, reqs, gap, **cfg_kw):
+                """One in-process daemon fed staggered requests; returns
+                (wall, packer) after a clean drain."""
+                shutil.rmtree(os.path.join("/tmp/vft_bench", sub),
+                              ignore_errors=True)
+                from video_features_tpu.extractors import get_extractor
+
+                svc = ExtractionService(
+                    get_extractor(mm_cfg(sub, **cfg_kw)),
+                    poll_interval=0.005)
+                feed_err = []
+
+                def feed():
+                    try:
+                        for i, (vids, ft) in enumerate(reqs):
+                            payload = {"tenant": f"t{i % 2}",
+                                       "videos": vids,
+                                       "request_id": f"{sub}-{i}"}
+                            if ft is not None:
+                                payload["feature_type"] = ft
+                            svc.submit(payload)
+                            time.sleep(gap)
+                    except Exception as e:  # noqa: BLE001 — re-raised on the bench thread after join
+                        feed_err.append(e)
+                    finally:
+                        svc.request_drain()
+
+                feeder = _threading.Thread(target=feed, daemon=True)
+                t0 = time.perf_counter()
+                feeder.start()
+                rc = svc.run()
+                wall = time.perf_counter() - t0
+                feeder.join()
+                if feed_err:
+                    raise feed_err[0]
+                if rc != 0:
+                    raise RuntimeError(f"{sub} daemon exited {rc}")
+                return wall, svc.packer
+
+            def chunk(vids):
+                return [vids[i:i + per_request]
+                        for i in range(0, len(vids), per_request)]
+
+            _log(f"multi_model_service: {n_per_model} videos/model, "
+                 f"batch {batch}, stagger {stagger}s")
+            # warm daemons fill the persistent XLA cache so first-request
+            # compile stalls don't swallow the singles' idle windows
+            run_daemon("mm_warm_a", [(chunk(corpus_a)[0], None)], 0.01)
+            run_daemon("mm_warm_b", [(chunk(corpus_b)[0], None)], 0.01,
+                       feature="r21d_rgb")
+            # singles: each model's half at its own arrival rate (gap 2×:
+            # the mixed stream delivers each model a request every 2×stagger)
+            wall_a, packer_a = run_daemon(
+                "mm_single_a", [(v, None) for v in chunk(corpus_a)],
+                2 * stagger)
+            wall_b, packer_b = run_daemon(
+                "mm_single_b", [(v, None) for v in chunk(corpus_b)],
+                2 * stagger, feature="r21d_rgb")
+            # mixed: the SAME per-model traffic interleaved into one daemon
+            mixed_reqs = []
+            for va, vb in zip(chunk(corpus_a), chunk(corpus_b)):
+                mixed_reqs.append((va, None))
+                mixed_reqs.append((vb, "r21d_rgb"))
+            wall_m, packer_m = run_daemon(
+                "mm_mixed", mixed_reqs, stagger,
+                serve_models=("r21d_rgb",))
+
+            def svc_entry(wall, packer, videos):
+                return {
+                    "wall_sec": round(wall, 3),
+                    "videos_per_sec": round(videos / wall, 3),
+                    "packing_occupancy": round(packer.occupancy, 4),
+                    "real_slots": packer.real_slots,
+                    "dispatched_slots": packer.dispatched_slots,
+                }
+            entry = {
+                "videos": 2 * n_per_model,
+                "requests": len(mixed_reqs),
+                "stagger_sec": stagger,
+                "unit": "device slots",
+                "mixed": dict(svc_entry(wall_m, packer_m, 2 * n_per_model),
+                              models=packer_m.model_stats()),
+                "single_resnet50": svc_entry(wall_a, packer_a, n_per_model),
+                "single_r21d_rgb": svc_entry(wall_b, packer_b, n_per_model),
+                "code_rev": code_rev,
+            }
+            best_single = max(
+                entry["single_resnet50"]["packing_occupancy"],
+                entry["single_r21d_rgb"]["packing_occupancy"])
+            entry["occupancy_gain_vs_best_single"] = round(
+                entry["mixed"]["packing_occupancy"] - best_single, 4)
+            details["multi_model_service"] = entry
+            clear_failure("multi_model_service")
+            flush_details()
+            _log(f"multi_model_service: mixed occupancy "
+                 f"{entry['mixed']['packing_occupancy']} vs singles "
+                 f"{entry['single_resnet50']['packing_occupancy']} / "
+                 f"{entry['single_r21d_rgb']['packing_occupancy']} "
+                 f"(gain {entry['occupancy_gain_vs_best_single']}), "
+                 f"{entry['mixed']['videos_per_sec']} videos/s aggregate")
+
     # ---- content-addressed feature cache (--cache_dir) ------------------------
     # Duplicate-heavy corpus (each unique video uploaded `dups` times, the
     # "millions of users" traffic shape): a cold pass measures in-run dedup

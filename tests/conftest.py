@@ -72,6 +72,7 @@ _FAST_MODULES = {
     "test_golden_pipeline",
     "test_ingest",
     "test_mirror_independence",
+    "test_multimodel",
     "test_packer",
     "test_packer_buckets",
     "test_parallel",

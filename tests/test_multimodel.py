@@ -1,0 +1,584 @@
+"""Co-resident models on one mesh (--serve_models): two-model daemon byte
+parity vs single-model runs, unknown/malformed-model rejection records,
+global cross-model tenant fairness + EDF preemption, the scaled staging-ring
+geometry cap, per-model stats, cache fingerprint isolation, breaker
+isolation across models, and the packer's (model, geometry) round-robin
+dispatch — through the same lightweight jitted extractors as
+tests/test_packer.py (shared program shapes, trivial CPU compiles)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from test_packer import ToyPacked, _write_video
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.extractors.base import derive_model_config
+from video_features_tpu.parallel.packer import CorpusPacker, PackSpec
+from video_features_tpu.parallel.pipeline import HostStagingRing
+from video_features_tpu.reliability import reset_faults
+from video_features_tpu.serve import (
+    ExtractionService,
+    RequestQueue,
+    RequestRejected,
+    SpoolWatcher,
+)
+from video_features_tpu.serve.request import ServiceRequest
+
+PRIMARY = "resnet50"  # ToyPacked's model name
+SECOND = "r21d_rgb"   # ToyPackedB's model name (toy stands in for the real net)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """Six decodable tiny videos of mixed lengths."""
+    d = tmp_path_factory.mktemp("mm_corpus")
+    return [_write_video(d / f"vid{i}.mp4", n)
+            for i, n in enumerate((3, 5, 9, 2, 4, 7))]
+
+
+class ToyPackedB(ToyPacked):
+    """A second co-residable toy model: different feature function AND a
+    different batch size, so its (model, geometry) buckets never share a
+    program with ToyPacked's."""
+
+    BATCH = 3
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+
+        def fwd(params, frames_u8):
+            x = frames_u8.astype(jnp.float32)
+            return jnp.stack([x.min(axis=(1, 2, 3)), x.std(axis=(1, 2, 3)),
+                              x.mean(axis=(1, 2, 3))], axis=-1)
+
+        self._step = self.runner.jit(fwd)
+
+    def extract(self, video_path):
+        feats = super().extract(video_path)
+        return feats  # shape differs via _step; (n, 3) rows
+
+    def pack_spec(self):
+        spec = super().pack_spec()
+        spec.empty_row_shape = (3,)
+        return spec
+
+
+def _cfg(tmp_path, sub, **kw):
+    kw.setdefault("retries", 1)
+    kw.setdefault("retry_backoff", 0.01)
+    kw.setdefault("feature_type", PRIMARY)
+    if kw.get("serve"):
+        kw.setdefault("spool_dir", str(tmp_path / sub / "spool"))
+        kw.setdefault("idle_flush_sec", 0.0)
+        os.makedirs(kw["spool_dir"], exist_ok=True)
+    return ExtractionConfig(
+        on_extraction="save_numpy", num_devices=1,
+        output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"), **kw)
+
+
+def _service(tmp_path, sub, **kw):
+    kw.setdefault("serve_models", (SECOND,))
+    cfg = _cfg(tmp_path, sub, serve=True, **kw)
+    ex = ToyPacked(cfg)
+
+    def factory(model):
+        assert model == SECOND
+        return ToyPackedB(derive_model_config(cfg, model))
+
+    return ExtractionService(ex, poll_interval=0.001, factory=factory)
+
+
+def _outputs(tmp_path, sub, model):
+    return {os.path.basename(p): np.load(p)
+            for p in glob.glob(str(tmp_path / sub / model / "*.npy"))}
+
+
+def _assert_bytes_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k].tobytes() == b[k].tobytes(), k
+
+
+# ---- acceptance: two-model daemon == two single-model runs -----------------
+
+
+def test_two_model_daemon_matches_single_model_runs(tmp_path, corpus):
+    vids_a, vids_b = corpus[:3], corpus[3:]
+    ex_a = ToyPacked(_cfg(tmp_path, "batch"))
+    assert ex_a.run(vids_a) == 3
+    ex_b = ToyPackedB(derive_model_config(_cfg(tmp_path, "batch"), SECOND))
+    assert ex_b.run(vids_b) == 3
+
+    svc = _service(tmp_path, "serve")
+    ra = svc.submit({"tenant": "alice", "videos": vids_a})  # default model
+    rb = svc.submit({"tenant": "bob", "videos": vids_b,
+                     "feature_type": SECOND})
+    assert ra.feature_type == PRIMARY  # admission resolved the default
+    assert rb.feature_type == SECOND
+    svc.request_drain()
+    assert svc.run() == 0
+    assert ra.state == "done" and rb.state == "done"
+    _assert_bytes_equal(_outputs(tmp_path, "serve", PRIMARY),
+                        _outputs(tmp_path, "batch", PRIMARY))
+    _assert_bytes_equal(_outputs(tmp_path, "serve", SECOND),
+                        _outputs(tmp_path, "batch", SECOND))
+    # result records carry the model; per-model manifests are separate
+    for r, model, vids in ((ra, PRIMARY, vids_a), (rb, SECOND, vids_b)):
+        path = os.path.join(svc.notify_dir, f"{r.request_id}.result.json")
+        with open(path) as f:
+            record = json.load(f)
+        assert record["feature_type"] == model
+        assert sorted(record["done"]) == sorted(
+            os.path.abspath(v) for v in vids)
+    # the shared packer dispatched BOTH models' buckets, scoped by name
+    stats = svc.packer.model_stats()
+    assert set(stats) == {PRIMARY, SECOND}
+    assert all(s["dispatched_slots"] > 0 for s in stats.values())
+
+
+def test_shared_mesh_staging_and_writer_across_models(tmp_path, corpus):
+    svc = _service(tmp_path, "shared")
+    r = svc.submit({"videos": corpus[:1]})
+    rb = svc.submit({"videos": corpus[3:4], "feature_type": SECOND})
+    for _ in range(400):
+        svc.step()
+        if r.complete and rb.complete:
+            break
+    assert r.state == "done" and rb.state == "done"
+    ex2 = svc.sessions.peek_extractor(SECOND)
+    assert ex2 is not None  # lazily constructed on first traffic
+    assert ex2.runner is svc.ex.runner  # one mesh
+    assert ex2._staging is svc.ex._staging  # one staging ring
+    assert ex2.clock is svc.ex.clock  # one service clock
+    assert ex2._writer is svc.ex._writer  # one async writer
+    # the ring's geometry cap scales with the loaded model count
+    assert (svc.ex._staging._max_geometries
+            == HostStagingRing.DEFAULT_MAX_GEOMETRIES * 2)
+    assert svc.packer._staging is svc.ex._staging
+    svc.request_drain()
+    assert svc.run() == 0
+
+
+def test_lazy_construction_skips_untrafficked_models(tmp_path, corpus):
+    svc = _service(tmp_path, "lazy")
+    r = svc.submit({"videos": corpus[:1]})  # primary-only traffic
+    svc.request_drain()
+    assert svc.run() == 0 and r.state == "done"
+    assert svc.sessions.peek_extractor(SECOND) is None
+
+
+# ---- rejection: unknown / malformed models ---------------------------------
+
+
+def test_unknown_model_rejected_cleanly(tmp_path, corpus):
+    svc = _service(tmp_path, "reject")
+    with pytest.raises(RequestRejected, match="not loaded"):
+        svc.submit({"videos": corpus[:1], "feature_type": "vggish"})
+    with pytest.raises(RequestRejected, match="non-empty string"):
+        svc.submit({"videos": corpus[:1], "feature_type": 7})
+    with pytest.raises(RequestRejected, match="non-empty string"):
+        svc.submit({"videos": corpus[:1], "feature_type": ""})
+    # spool path: the daemon records the rejection where the submitter looks
+    spool = svc.cfg.spool_dir
+    with open(os.path.join(spool, "bad_model.json"), "w") as f:
+        json.dump({"videos": corpus[:1], "feature_type": "vggish"}, f)
+    watcher = SpoolWatcher(spool, svc)
+    assert watcher.scan_once() == 1
+    assert os.path.exists(os.path.join(spool, "bad_model.json.rejected"))
+    result = os.path.join(svc.notify_dir, "bad_model.result.json")
+    with open(result) as f:
+        record = json.load(f)
+    assert record["state"] == "rejected" and "not loaded" in record["reason"]
+    # the daemon keeps serving loaded models after the rejection
+    r = svc.submit({"videos": corpus[:1]})
+    svc.request_drain()
+    assert svc.run() == 0 and r.state == "done"
+
+
+def test_model_construction_failure_fails_job_not_daemon(tmp_path, corpus):
+    """A co-loaded model whose lazy construction dies (missing weights,
+    bad derived config) fails ITS videos cleanly — classified in the
+    request record and the model's failure manifest, exit code 1 — while
+    the primary model keeps serving."""
+    from video_features_tpu.reliability import load_failures
+
+    cfg = _cfg(tmp_path, "ctorfail", serve=True, serve_models=(SECOND,),
+               retries=0)
+
+    def broken_factory(model):
+        raise RuntimeError("checkpoint store unreachable")
+
+    svc = ExtractionService(ToyPacked(cfg), poll_interval=0.001,
+                            factory=broken_factory)
+    rb = svc.submit({"videos": corpus[3:4], "feature_type": SECOND})
+    ra = svc.submit({"videos": corpus[:1]})
+    svc.request_drain()
+    assert svc.run() == 1  # the construction failure keeps the exit honest
+    assert ra.state == "done"
+    assert rb.state == "failed"
+    assert "checkpoint store unreachable" in rb.failed[0]["message"]
+    # manifested under the FAILED model's own output tree
+    failures = load_failures(os.path.join(str(tmp_path / "ctorfail"), SECOND))
+    assert set(failures) == {os.path.abspath(corpus[3])}
+
+
+def test_inflight_path_resubmission_rejected_across_models(tmp_path, corpus):
+    """A popped-but-unfinished video (rows/writes pending) is invisible to
+    the scheduler's queued-duplicate check; admission must still reject a
+    resubmission — same or another model — or the second begin() would
+    discard the first attempt's in-flight assembly."""
+    svc = _service(tmp_path, "inflight")
+    r = svc.submit({"videos": corpus[:1]})
+    # simulate the popped-but-pending window: the job is in _jobs, gone
+    # from the scheduler queue
+    job = svc.queue.next_job()
+    svc._jobs[job.path] = job
+    with pytest.raises(RequestRejected, match="in flight"):
+        svc.submit({"videos": corpus[:1], "feature_type": SECOND,
+                    "request_id": "dup"})
+    with pytest.raises(RequestRejected, match="in flight"):
+        svc.submit({"videos": corpus[:1], "request_id": "dup2"})
+    # release the window: the path completes normally afterwards
+    svc.queue.requeue(job)
+    del svc._jobs[job.path]
+    svc.request_drain()
+    assert svc.run() == 0 and r.state == "done"
+
+
+def test_single_model_daemon_rejects_other_models(tmp_path, corpus):
+    cfg = _cfg(tmp_path, "single", serve=True)
+    svc = ExtractionService(ToyPacked(cfg), poll_interval=0.001)
+    with pytest.raises(RequestRejected, match="not loaded"):
+        svc.submit({"videos": corpus[:1], "feature_type": SECOND})
+    svc.request_drain()
+    assert svc.run() == 0
+    svc.close()
+
+
+# ---- global fairness and EDF across models ---------------------------------
+
+
+def _req(tenant, videos, feature_type=None, deadline=None):
+    return ServiceRequest(f"r-{tenant}-{len(videos)}", tenant, tuple(videos),
+                          deadline=deadline, feature_type=feature_type)
+
+
+def test_fairness_is_global_across_models():
+    """Equal-weight tenants on DIFFERENT models alternate pops — fairness
+    never silos per model."""
+    q = RequestQueue()
+    q.submit(_req("alice", [f"/a{i}" for i in range(4)], feature_type="m_a"))
+    q.submit(_req("bob", [f"/b{i}" for i in range(4)], feature_type="m_b"))
+    order = [q.next_job().feature_type for _ in range(8)]
+    assert order[:2] in (["m_a", "m_b"], ["m_b", "m_a"])
+    assert order.count("m_a") == order.count("m_b") == 4
+    # strict alternation under equal weights
+    assert all(order[i] != order[i + 1] for i in range(7))
+
+
+def test_edf_urgent_model_b_preempts_queued_model_a():
+    import time as _time
+
+    q = RequestQueue()
+    q.submit(_req("slow", ["/a0", "/a1", "/a2"], feature_type="m_a"))
+    q.submit(_req("urgent", ["/b0"], feature_type="m_b",
+                  deadline=_time.time() + 5))
+    job = q.next_job()
+    assert job.feature_type == "m_b" and job.path == "/b0"
+
+
+def test_service_interleaves_completions_across_models(tmp_path, corpus):
+    """Two equal-weight tenants on two models: the daemon's ingest order
+    alternates models (the scheduler is model-agnostic), so neither model's
+    queue monopolizes the mesh."""
+    svc = _service(tmp_path, "fair")
+    ingests = []
+    orig = svc.session.ingest
+
+    def spy(path, model, retries=None):
+        ingests.append(model)
+        return orig(path, model, retries=retries)
+
+    svc.session.ingest = spy
+    ra = svc.submit({"tenant": "alice", "videos": corpus[:3]})
+    rb = svc.submit({"tenant": "bob", "videos": corpus[3:],
+                     "feature_type": SECOND})
+    svc.request_drain()
+    assert svc.run() == 0
+    assert ra.state == "done" and rb.state == "done"
+    assert len(ingests) == 6
+    # stride scheduling at equal weights: strict model alternation
+    assert all(ingests[i] != ingests[i + 1] for i in range(5))
+
+
+def test_breaker_isolation_across_models(tmp_path, corpus, monkeypatch):
+    """alice's poisoned model-A videos trip HER breaker; bob's model-B
+    traffic keeps completing on the same daemon."""
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:vid0")
+    svc = _service(tmp_path, "poison", tenant_max_failures=0)
+    ra = svc.submit({"tenant": "alice", "videos": corpus[:2]})
+    rb = svc.submit({"tenant": "bob", "videos": corpus[3:],
+                     "feature_type": SECOND})
+    svc.request_drain()
+    assert svc.run() == 1
+    assert ra.state in ("failed", "partial")
+    assert rb.state == "done"
+    assert svc.breaker.tripped("alice") and not svc.breaker.tripped("bob")
+
+
+# ---- feature cache composition ---------------------------------------------
+
+
+def test_cache_fingerprints_isolate_models(tmp_path, corpus):
+    """The same video bytes served under both models produce two distinct
+    cache entries (the fingerprint includes the model config) and replay as
+    hits only within their own model."""
+    cache_dir = str(tmp_path / "cache")
+    svc = _service(tmp_path, "cachemm", cache_dir=cache_dir)
+    vid = corpus[0]
+    ra = svc.submit({"videos": [vid], "request_id": "a1"})
+    for _ in range(400):
+        svc.step()
+        if ra.complete:
+            break
+    assert ra.state == "done" and ra.cache_hits == 0
+    # same bytes, other model: a MISS (different fingerprint), fresh extract
+    rb = svc.submit({"videos": [vid], "feature_type": SECOND,
+                     "request_id": "b1"})
+    for _ in range(400):
+        svc.step()
+        if rb.complete:
+            break
+    assert rb.state == "done" and rb.cache_hits == 0
+    # replay under the primary model: a pure hit now
+    ra2 = svc.submit({"videos": [vid], "request_id": "a2"})
+    for _ in range(400):
+        svc.step()
+        if ra2.complete:
+            break
+    assert ra2.state == "done" and ra2.cache_hits == 1
+    svc.request_drain()
+    assert svc.run() == 0
+    # the two models' outputs differ (different feature functions) and each
+    # landed in its own subtree
+    a = _outputs(tmp_path, "cachemm", PRIMARY)
+    b = _outputs(tmp_path, "cachemm", SECOND)
+    stem = os.path.basename(vid).replace(".mp4", "")
+    assert a[f"{stem}_feat.npy"].shape[1] == 2
+    assert b[f"{stem}_feat.npy"].shape[1] == 3
+
+
+# ---- long-run residue (multi-model soak) -----------------------------------
+
+
+def test_multimodel_soak_no_residue(tmp_path, corpus):
+    svc = _service(tmp_path, "soak")
+    for i in range(3):
+        ra = svc.submit({"tenant": "a", "videos": corpus[:2],
+                         "request_id": f"sa{i}"})
+        rb = svc.submit({"tenant": "b", "videos": corpus[3:5],
+                         "feature_type": SECOND, "request_id": f"sb{i}"})
+        for _ in range(800):
+            svc.step()
+            if ra.complete and rb.complete:
+                break
+        assert ra.state == "done" and rb.state == "done"
+        packer = svc.packer
+        assert not packer.has_pending()
+        assert (len(packer.video_clips), len(packer._video_keys),
+                len(packer._video_model), len(packer._finished),
+                len(svc._requests), len(svc._jobs),
+                svc.sessions.pending_writes(),
+                len(svc.sessions._ex_for_path)) == (0,) * 8
+    svc.close()
+
+
+# ---- packer engine: (model, geometry) keys + round-robin dispatch ----------
+
+
+def _spec(batch, tag):
+    calls = []
+
+    def step(batch_arr):
+        calls.append(tag)
+        return batch_arr.sum(axis=tuple(range(1, batch_arr.ndim)),
+                             keepdims=True)[:, 0]
+
+    return PackSpec(batch_size=batch, empty_row_shape=(1,), open_clips=None,
+                    step=step, finalize=None), calls
+
+
+def test_packer_multi_spec_batch_sizes_and_stats():
+    spec_a, calls_a = _spec(2, "a")
+    spec_b, calls_b = _spec(3, "b")
+    packer = CorpusPacker()
+    packer.register_model("a", spec_a)
+    packer.register_model("b", spec_b)
+    packer.begin("va", {}, model="a")
+    packer.begin("vb", {}, model="b")
+    for _ in range(2):
+        packer.add("va", np.ones((2, 2), np.float32))  # fills a's batch of 2
+    for _ in range(3):
+        packer.add("vb", np.ones((2, 2), np.float32))  # fills b's batch of 3
+    assert calls_a == ["a"] and calls_b == ["b"]
+    packer.finish("va")
+    packer.finish("vb")
+    packer.flush()
+    done = {a.video: a for a in (packer.pop_completed(model="a")
+                                 + packer.pop_completed(model="b"))}
+    assert set(done) == {"va", "vb"}
+    # same geometry, distinct (model, geometry) buckets with scoped names
+    stats = packer.bucket_stats()
+    assert set(stats) == {"a:2x2", "b:2x2"}
+    assert stats["a:2x2"]["dispatched_slots"] == 2
+    assert stats["b:2x2"]["dispatched_slots"] == 3
+    per_model = packer.model_stats()
+    assert per_model["a"]["occupancy"] == 1.0
+    assert per_model["b"]["real_slots"] == 3
+
+
+def test_packer_pop_completed_scopes_by_model():
+    spec_a, _ = _spec(4, "a")
+    spec_b, _ = _spec(4, "b")
+    packer = CorpusPacker()
+    packer.register_model("a", spec_a)
+    packer.register_model("b", spec_b)
+    for name, model in (("va", "a"), ("vb", "b")):
+        packer.begin(name, {}, model=model)
+        packer.add(name, np.ones((2,), np.float32))
+        packer.finish(name)
+    packer.flush()
+    assert [a.video for a in packer.pop_completed(model="a")] == ["va"]
+    assert [a.video for a in packer.pop_completed(model="b")] == ["vb"]
+
+
+def test_packer_flush_round_robins_across_models():
+    """Model a holds ready batches in TWO geometry buckets, model b in one:
+    the corpus flush serves one batch per model per round (a, b, a) instead
+    of draining a's whole backlog before b's ready batch dispatches."""
+    order = []
+
+    def step_for(tag):
+        def step(batch_arr):
+            order.append(tag)
+            return batch_arr.sum(axis=tuple(range(1, batch_arr.ndim)),
+                                 keepdims=True)[:, 0]
+        return step
+
+    spec_a = PackSpec(batch_size=4, empty_row_shape=(1,), open_clips=None,
+                      step=step_for("a"), finalize=None)
+    spec_b = PackSpec(batch_size=4, empty_row_shape=(1,), open_clips=None,
+                      step=step_for("b"), finalize=None)
+    packer = CorpusPacker()
+    packer.register_model("a", spec_a)
+    packer.register_model("b", spec_b)
+    packer.begin("va", {}, model="a")
+    packer.add("va", np.ones((2, 2), np.float32))  # a bucket 1 (partial)
+    packer.add("va", np.ones((3, 3), np.float32))  # a bucket 2 (partial)
+    packer.begin("vb", {}, model="b")
+    packer.add("vb", np.ones((2, 2), np.float32))  # b bucket (partial)
+    packer.finish("va")
+    packer.finish("vb")
+    packer.flush()
+    assert order == ["a", "b", "a"]
+    assert {a.video for a in (packer.pop_completed(model="a")
+                              + packer.pop_completed(model="b"))} == {
+        "va", "vb"}
+
+
+def test_packer_register_unknown_model_begin_raises():
+    spec_a, _ = _spec(2, "a")
+    packer = CorpusPacker(spec_a)
+    with pytest.raises(KeyError, match="not registered"):
+        packer.begin("v", {}, model="nope")
+
+
+# ---- staging ring geometry cap (satellite unit test) -----------------------
+
+
+def test_staging_ring_geometry_cap_is_constructor_scaled():
+    ring = HostStagingRing(depth=1, max_geometries=2)
+    ring.stage([np.ones((2, 2), np.uint8)])
+    ring.stage([np.ones((3, 3), np.uint8)])
+    assert ring.evicted_geometries == 0
+    ring.stage([np.ones((4, 4), np.uint8)])  # third geometry: evicts LRU
+    assert ring.evicted_geometries == 1
+    big = HostStagingRing(depth=1, max_geometries=4)
+    for n in (2, 3, 4, 5):
+        big.stage([np.ones((n, n), np.uint8)])
+    assert big.evicted_geometries == 0
+    assert HostStagingRing.DEFAULT_MAX_GEOMETRIES == 8
+
+
+# ---- config/CLI surface ----------------------------------------------------
+
+
+def test_serve_models_config_validation(tmp_path):
+    cfg = _cfg(tmp_path, "vcfg", serve=True, serve_models=(SECOND,))
+    cfg.validate()
+    with pytest.raises(ValueError, match="needs --serve"):
+        _cfg(tmp_path, "vcfg2", serve_models=(SECOND,)).validate()
+    with pytest.raises(ValueError, match="unknown serve_models"):
+        cfg.replace(serve_models=("nope",)).validate()
+
+
+def test_serve_models_cli_round_trip(tmp_path):
+    from video_features_tpu.cli import parse_args
+
+    spool = str(tmp_path / "spool")
+    os.makedirs(spool, exist_ok=True)
+    cfg = parse_args([
+        "--feature_type", PRIMARY, "--on_extraction", "save_numpy",
+        "--serve", "--spool_dir", spool,
+        "--serve_models", SECOND, "vggish"])
+    assert cfg.serve_models == (SECOND, "vggish")
+
+
+def test_derive_model_config_resets_per_model_defaults(tmp_path):
+    from video_features_tpu.config import resolve_model_defaults
+
+    cfg = _cfg(tmp_path, "derive", feature_type="i3d", serve=True,
+               serve_models=(SECOND,), extraction_fps=5, side_size=300)
+    resolved = resolve_model_defaults(cfg)
+    assert resolved.stack_size == 64  # i3d's default
+    derived = resolve_model_defaults(
+        derive_model_config(resolved, SECOND))
+    assert derived.feature_type == SECOND
+    assert derived.stack_size == 16  # r21d's own default, not i3d's 64
+    # primary-only model-scoped flags do NOT leak: r21d would reject the
+    # inherited extraction_fps outright at daemon startup
+    assert derived.extraction_fps is None and derived.side_size is None
+    derived.validate()
+
+
+def test_primary_only_extraction_fps_does_not_block_co_model(tmp_path,
+                                                             corpus):
+    """--extraction_fps on the primary must not make the daemon refuse to
+    start because a co-loaded r21d (which rejects the flag) inherits it."""
+    svc = _service(tmp_path, "fpsleak", extraction_fps=5)
+    assert svc.models == (PRIMARY, SECOND)
+    svc.request_drain()
+    assert svc.run() == 0
+
+
+def test_decode_hints_never_construct_a_model(tmp_path, corpus):
+    svc = _service(tmp_path, "hintlazy", decode_workers=2)
+    assert svc.sessions.peek_extractor(SECOND) is None
+    svc.sessions.schedule_decode(corpus[3], SECOND)  # hint for unbuilt model
+    assert svc.sessions.peek_extractor(SECOND) is None  # still unbuilt
+    svc.request_drain()
+    assert svc.run() == 0

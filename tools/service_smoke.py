@@ -11,9 +11,15 @@ driving the REAL CLI surface as an operator would — no test harness imports:
 3. a RESUBMIT of alice's videos must be served entirely from the feature
    cache (``cache_hits`` in its result record, hits in the socket ``stats``
    op — docs/caching.md);
-4. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
-   records for every request, a complete done-manifest, and byte-identical
-   ``.npy`` outputs against the batch runs.
+4. the daemon co-loads a second model (``--serve_models r21d_rgb``,
+   docs/serving.md): a mixed-traffic step submits carol's request with
+   ``"feature_type": "r21d_rgb"`` to the SAME daemon and asserts
+   byte-parity against a single-model r21d batch run, per-model sections
+   in the socket ``stats`` op, and a clean ``rejected`` record for a
+   request naming an unloaded model;
+5. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
+   records for every request, complete per-model done-manifests, and
+   byte-identical ``.npy`` outputs against the batch runs.
 
 Runs on CPU with deterministic random weights::
 
@@ -49,15 +55,15 @@ def write_video(path, frames, size=(32, 24)):
     return path
 
 
-def cli(out_dir, *extra):
+def cli(out_dir, *extra, feature="resnet50"):
     return [sys.executable, os.path.join(REPO, "main.py"),
-            "--feature_type", "resnet50", "--on_extraction", "save_numpy",
+            "--feature_type", feature, "--on_extraction", "save_numpy",
             "--batch_size", "4", "--output_path", out_dir, *extra]
 
 
-def outputs(out_dir):
+def outputs(out_dir, feature="resnet50"):
     return {os.path.basename(p): np.load(p)
-            for p in glob.glob(os.path.join(out_dir, "resnet50", "*.npy"))}
+            for p in glob.glob(os.path.join(out_dir, feature, "*.npy"))}
 
 
 def sock_op(sock_path, op):
@@ -102,20 +108,30 @@ def main() -> int:
                         for i, n in enumerate((3, 6))],
               "bob": [write_video(os.path.join(root, f"b{i}.mp4"), n)
                       for i, n in enumerate((5, 2))]}
+    # carol's videos go to the co-loaded r21d_rgb model (>=16 frames: one
+    # full reference stack each)
+    r21d_videos = [write_video(os.path.join(root, f"c{i}.mp4"), n)
+                   for i, n in enumerate((16, 18))]
 
     print("[smoke] per-tenant batch reference runs")
     for tenant, vids in videos.items():
         subprocess.run(cli(os.path.join(root, f"batch_{tenant}"),
                            "--video_paths", *vids),
                        env=env, check=True, timeout=TIMEOUT)
+    print("[smoke] single-model r21d_rgb batch reference run")
+    subprocess.run(cli(os.path.join(root, "batch_r21d"),
+                       "--video_paths", *r21d_videos, feature="r21d_rgb"),
+                   env=env, check=True, timeout=TIMEOUT)
 
     spool = os.path.join(root, "spool")
     os.makedirs(spool)
     serve_out = os.path.join(root, "serve")
-    print("[smoke] starting the daemon")
+    print("[smoke] starting the daemon (co-resident models: resnet50 + "
+          "r21d_rgb)")
     daemon = subprocess.Popen(
         cli(serve_out, "--serve", "--spool_dir", spool,
             "--idle_flush_sec", "0.05", "--spool_poll_sec", "0.05",
+            "--serve_models", "r21d_rgb",
             "--cache_dir", os.path.join(root, "cache")),
         env=env)
     try:
@@ -154,6 +170,48 @@ def main() -> int:
               f"({record['cache_hits']} hits; cumulative hit rate "
               f"{stats['cache']['hit_rate']:.0%})")
 
+        # two-model mixed traffic: carol's r21d_rgb request rides the SAME
+        # daemon/mesh as the resnet50 tenants; byte parity vs the
+        # single-model batch run is asserted after the drain below
+        print("[smoke] submitting carol's r21d_rgb request (co-resident "
+              "model)")
+        drop_request(spool, "req_carol",
+                     {"tenant": "carol", "videos": r21d_videos,
+                      "feature_type": "r21d_rgb"})
+        carol = os.path.join(spool, "results", "req_carol.result.json")
+        await_results(daemon, [carol], time.time() + TIMEOUT)
+        with open(carol) as f:
+            record = json.load(f)
+        assert record["state"] == "done", record
+        assert record["feature_type"] == "r21d_rgb", record
+
+        # a request naming an UNLOADED model must produce a clean rejection
+        # record, not a daemon crash or a silent terminal failure
+        print("[smoke] submitting a request for an unloaded model "
+              "(expect rejection record)")
+        drop_request(spool, "req_unknown",
+                     {"tenant": "carol", "videos": videos["alice"],
+                      "feature_type": "vggish"})
+        unknown = os.path.join(spool, "results", "req_unknown.result.json")
+        await_results(daemon, [unknown], time.time() + TIMEOUT)
+        with open(unknown) as f:
+            record = json.load(f)
+        assert record["state"] == "rejected", record
+        assert "not loaded" in record["reason"], record
+        assert os.path.exists(os.path.join(spool,
+                                           "req_unknown.json.rejected"))
+
+        stats = sock_op(os.path.join(spool, "control.sock"), {"op": "stats"})
+        assert stats["serving_models"] == ["resnet50", "r21d_rgb"], stats
+        assert set(stats["models"]) == {"resnet50", "r21d_rgb"}, \
+            stats["models"]
+        for model, m in stats["models"].items():
+            assert m["videos_ok"] > 0 and m["dispatched_slots"] > 0, \
+                (model, m)
+        print(f"[smoke] per-model stats: "
+              + ", ".join(f"{m}: occupancy {s['occupancy']}"
+                          for m, s in stats["models"].items()))
+
         print("[smoke] SIGTERM → graceful drain")
         daemon.send_signal(signal.SIGTERM)
         assert daemon.wait(timeout=TIMEOUT) == 0, daemon.returncode
@@ -169,14 +227,27 @@ def main() -> int:
     for name in sorted(want):
         assert got[name].tobytes() == want[name].tobytes(), \
             f"{name}: daemon output differs from the batch run"
+    # the co-resident model's outputs: byte-identical to the single-model
+    # r21d batch run, in r21d's own output subtree
+    got_r = outputs(serve_out, feature="r21d_rgb")
+    want_r = outputs(os.path.join(root, "batch_r21d"), feature="r21d_rgb")
+    assert set(got_r) == set(want_r) and got_r, (sorted(got_r),
+                                                 sorted(want_r))
+    for name in sorted(want_r):
+        assert got_r[name].tobytes() == want_r[name].tobytes(), \
+            f"{name}: two-model daemon r21d output differs from batch run"
     manifest = os.path.join(serve_out, "resnet50", ".done_manifest.jsonl")
     # cache-hit replays append their own records (resume-vs-cache layering
     # is deterministic), so count DISTINCT videos, not lines
     with open(manifest) as f:
         done = {json.loads(line)["video"] for line in f}
     assert len(done) == 4, f"done-manifest incomplete: {sorted(done)}"
-    print(f"[smoke] PASS: {len(want)} outputs byte-identical, "
-          "manifests intact")
+    with open(os.path.join(serve_out, "r21d_rgb",
+                           ".done_manifest.jsonl")) as f:
+        done_r = {json.loads(line)["video"] for line in f}
+    assert len(done_r) == len(r21d_videos), sorted(done_r)
+    print(f"[smoke] PASS: {len(want)} + {len(want_r)} outputs "
+          "byte-identical across two co-resident models, manifests intact")
     return 0
 
 

@@ -29,12 +29,12 @@ ALLOWED: Dict[str, int] = {
     "video_features_tpu/extractors/base.py": 6,    # per-video fault barrier (per-video + packed loops) + packed finalize + corpus-flush arms + async-write reap arm + unwind-path write accounting
     "video_features_tpu/extractors/flow.py": 3,    # async-copy + imshow probes + precompile warmup
     "video_features_tpu/io/output.py": 1,          # writer thread: error stored on the WriteHandle
-    "video_features_tpu/parallel/packer.py": 2,    # stale-flush + corpus-flush arms: each bucket's victims, not the finisher or a healthy co-resident bucket, own the failure
+    "video_features_tpu/parallel/packer.py": 4,    # stale-flush + corpus-flush, dispatch + scatter arms each: every bucket's victims, not the finisher or a healthy co-resident bucket/model, own the failure
     "video_features_tpu/parallel/pipeline.py": 2,  # distributed-client probe + worker re-raise
     "video_features_tpu/reliability/retry.py": 2,  # classified re-raise + attempts attr
     "video_features_tpu/reliability/watchdog.py": 1,  # hands the exception to the waiter
     "video_features_tpu/run.py": 1,                # best-effort JAX_PLATFORMS shim
-    "video_features_tpu/serve/daemon.py": 4,       # per-video isolation point (serving loop) + cache-hit write arm + best-effort rejection/result records (the daemon must outlive a full notify disk)
+    "video_features_tpu/serve/daemon.py": 5,       # per-video isolation point (serving loop) + lazy model-construction arm + cache-hit write arm + best-effort rejection/result records (the daemon must outlive a full notify disk)
     "video_features_tpu/serve/ingest.py": 1,       # one bad socket client must not kill the API thread
 }
 

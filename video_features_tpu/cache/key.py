@@ -97,6 +97,11 @@ EXECUTION_FIELDS = (
     "spool_poll_sec",          # ingest polling
     "cache_dir",               # the cache's own location
     "cache_max_bytes",         # the cache's own budget
+    "serve_models",            # which models a daemon co-loads; each job's
+                               # key fingerprints ITS model's derived config
+                               # (feature_type et al. above), so co-resident
+                               # serving shares entries with single-model
+                               # runs — pinned by tests/test_multimodel.py
 )
 
 # checkpoint names each feature type resolves (weights/store.py callers)

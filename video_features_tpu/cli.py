@@ -246,6 +246,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "occupancy when there is nothing to pack with)")
     parser.add_argument("--spool_poll_sec", type=float, default=0.25,
                         help="--serve: spool directory poll interval")
+    parser.add_argument("--serve_models", nargs="+",
+                        choices=list(FEATURE_TYPES), default=None,
+                        help="--serve: co-load these additional feature "
+                             "types into the SAME daemon and mesh — "
+                             "requests pick one via their 'feature_type' "
+                             "key (--feature_type stays the default) and "
+                             "the packer interleaves dispatch round-robin "
+                             "across models, so mixed traffic never drains "
+                             "the device. Each model keeps its own output "
+                             "subtree, manifests, reference stack/step "
+                             "defaults, and cache fingerprint "
+                             "(docs/serving.md)")
     # Feature cache (docs/caching.md)
     parser.add_argument("--cache_dir", default=None,
                         help="content-addressed feature cache: "

@@ -243,6 +243,17 @@ class ExtractionConfig:
     idle_flush_sec: float = 0.5
     # Spool directory poll interval.
     spool_poll_sec: float = 0.25
+    # Co-resident serving models (--serve only): additional feature types to
+    # serve from the SAME daemon and mesh. --feature_type stays the default
+    # for requests that omit "feature_type"; each co-loaded model's
+    # extractor is constructed lazily on first traffic with its own
+    # reference stack/step/stream defaults (explicit per-model overrides
+    # apply only to the primary), its own output subtree and manifests, and
+    # its own cache fingerprint — while sharing the mesh, the staging ring,
+    # the decode pool, the output writer, and the packer's interleaved
+    # (model, geometry) dispatch (docs/serving.md). None/empty = the
+    # single-model daemon.
+    serve_models: Optional[Tuple[str, ...]] = None
     # --- feature cache (docs/caching.md) ---
     # Content-addressed feature cache directory: sha256(container bytes) ×
     # model-config fingerprint → finished feature dict. A hit skips decode
@@ -360,6 +371,14 @@ class ExtractionConfig:
                              "cache directory)")
         if self.spool_poll_sec <= 0:
             raise ValueError("spool_poll_sec must be > 0")
+        if self.serve_models:
+            if not self.serve:
+                raise ValueError("--serve_models co-loads models into the "
+                                 "serving daemon; it needs --serve")
+            bad = set(self.serve_models) - set(FEATURE_TYPES)
+            if bad:
+                raise ValueError(f"unknown serve_models {sorted(bad)}; "
+                                 f"expected a subset of {FEATURE_TYPES}")
         if self.serve:
             if not self.spool_dir:
                 raise ValueError("--serve requires --spool_dir (the watched "
@@ -417,7 +436,7 @@ def config_from_namespace(ns) -> ExtractionConfig:
     for key, value in vars(ns).items():
         if key not in fields:
             continue
-        if key in ("video_paths", "streams") and value is not None:
+        if key in ("video_paths", "streams", "serve_models") and value is not None:
             value = tuple(value)
         kw[key] = value
     if kw.get("video_paths") is None:

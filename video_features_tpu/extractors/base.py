@@ -12,6 +12,7 @@ backoff for transient failures, a per-video watchdog, a failure manifest, and a
 from __future__ import annotations
 
 import abc
+import contextlib
 import os
 import sys
 import threading
@@ -56,6 +57,25 @@ from ..utils.metrics import (
 )
 
 
+# Active only while the multi-model serving layer (MultiModelSessions)
+# constructs a co-resident model's extractor: the dict names already-built
+# resources (mesh runner, host staging ring) the new extractor must REUSE
+# instead of building its own — co-resident models share one mesh and one
+# staging budget by design. Set/cleared on the constructing (daemon) thread
+# only, inside _shared_construction; never touched from worker threads.
+_CONSTRUCTION_SHARING: Dict[str, object] = {}
+
+
+@contextlib.contextmanager
+def _shared_construction(**resources):
+    """Make ``Extractor.__init__`` reuse ``resources`` for the duration."""
+    _CONSTRUCTION_SHARING.update(resources)
+    try:
+        yield
+    finally:
+        _CONSTRUCTION_SHARING.clear()
+
+
 class Extractor(abc.ABC):
     """Base class for all per-model pipelines."""
 
@@ -83,8 +103,11 @@ class Extractor(abc.ABC):
         self.tmp_dir = os.path.join(cfg.tmp_path, cfg.feature_type)
         # data-parallel mesh every device step runs on; --num_devices selects the
         # mesh size (None = all local devices), replacing the reference's
-        # thread-per-GPU dispatch (/root/reference/main.py:37-47)
-        self.runner = MeshRunner(cfg.num_devices, cfg.matmul_precision)
+        # thread-per-GPU dispatch (/root/reference/main.py:37-47). A model
+        # co-loaded by the multi-model serving layer reuses the primary
+        # extractor's runner (one mesh for all co-resident models).
+        self.runner = (_CONSTRUCTION_SHARING.get("runner")
+                       or MeshRunner(cfg.num_devices, cfg.matmul_precision))
         # per-video stage clock; active only when metrics are enabled (run())
         self.clock: Optional[StageClock] = None
         # cross-video decode pool; created by run() when --decode_workers > 1
@@ -100,9 +123,12 @@ class Extractor(abc.ABC):
         # its device_put is pending, and blocked-on-transfer time lands on
         # the 'transfer' stage. Depth covers the prefetch pipeline (`depth`
         # transfers in flight + one being consumed + one being filled).
-        self._staging = HostStagingRing(
-            depth=max(cfg.prefetch_depth, 1) + 2,
-            on_wait=self._transfer_wait)
+        # (a co-loaded model shares the primary's ring: one staging budget,
+        # one commit discipline, across every co-resident model's batches)
+        self._staging = (_CONSTRUCTION_SHARING.get("staging")
+                         or HostStagingRing(
+                             depth=max(cfg.prefetch_depth, 1) + 2,
+                             on_wait=self._transfer_wait))
         if cfg.device_resize and not type(self).supports_device_resize:
             print(f"--device_resize ignored: {cfg.feature_type} has no "
                   "device-side resize path (resnet50 only); keeping the "
@@ -135,7 +161,17 @@ class Extractor(abc.ABC):
 
             try:
                 self._cache_fp = fingerprint_digest(cfg)
-                self._cache = FeatureCache(cfg.cache_dir, cfg.cache_max_bytes)
+                # a co-loaded serving model reuses the primary's store (one
+                # LRU clock over the shared dir, and no redundant restart
+                # rescan on the daemon thread); the fingerprint above stays
+                # per model, so entries never collide. Key PRESENT with None
+                # inherits the primary's disabled state (its store failed to
+                # open — two independent stores over one dir would be worse)
+                if "cache" in _CONSTRUCTION_SHARING:
+                    self._cache = _CONSTRUCTION_SHARING["cache"]
+                else:
+                    self._cache = FeatureCache(cfg.cache_dir,
+                                               cfg.cache_max_bytes)
             except OSError as e:
                 # an unreadable checkpoint / cache dir disables the cache for
                 # this run (pass-through), it must not block extraction
@@ -824,17 +860,32 @@ class PackedSession:
     and per-tenant bookkeeping. ``forget_completed=True`` additionally drops
     the packer's per-video stats as each video resolves, bounding memory over
     an unbounded request stream (batch runs keep them for ``_pack_stats``).
+
+    ``packer``/``model``: the multi-model serving layer
+    (:class:`MultiModelSessions`) passes an already-built SHARED packer and
+    registers this session's spec under its feature-type name — every
+    co-resident model's session then feeds one ``(model, geometry)``-keyed
+    packer on one mesh. Default (batch runs): build a private single-spec
+    packer, keys unscoped.
     """
 
     def __init__(self, ex: Extractor, spec, on_done=None, on_failed=None,
-                 forget_completed: bool = False):
+                 forget_completed: bool = False, packer=None,
+                 model: Optional[str] = None):
         from ..parallel.packer import CorpusPacker
 
         self.ex = ex
         self.spec = spec
-        self.packer = CorpusPacker(spec, wait=ex._wait, clock=ex.clock,
-                                   flush_age=ex.cfg.pack_flush_age,
-                                   staging=ex._staging)
+        self.model = model
+        if packer is None:
+            packer = CorpusPacker(spec, wait=ex._wait, clock=ex.clock,
+                                  flush_age=ex.cfg.pack_flush_age,
+                                  staging=ex._staging)
+            if model is not None:
+                packer.register_model(model, spec)
+        else:
+            packer.register_model(model, spec)
+        self.packer = packer
         self._on_done = on_done
         self._on_failed = on_failed
         self._forget = forget_completed
@@ -878,7 +929,7 @@ class PackedSession:
         deadline = (time.perf_counter() + timeout) if timeout else None
         fault_point("extract", path)
         info, clips = self.spec.open_clips(path)
-        packer.begin(path, info)
+        packer.begin(path, info, model=self.model)
         try:
             for clip in clips:
                 packer.add(path, clip)
@@ -906,7 +957,7 @@ class PackedSession:
     def emit_completed(self, reap_limit: int = 1) -> None:
         """Finalize every video whose last clip's features have landed."""
         ex = self.ex
-        for asm in self.packer.pop_completed():
+        for asm in self.packer.pop_completed(model=self.model):
             try:
                 feats = self.spec.finalize(
                     asm.video, asm.stacked(self.spec.empty_row_shape),
@@ -942,21 +993,20 @@ class PackedSession:
         also reaps every pending write); the daemon calls it with
         ``final=False`` whenever the ingest queue goes idle — latency over
         occupancy when there is nothing left to pack with — and once more at
-        graceful shutdown.
+        graceful shutdown. (A multi-model daemon flushes the SHARED packer
+        once and then runs each session's :meth:`_resolve_drained` —
+        :meth:`MultiModelSessions.drain`.)
         """
+        self._resolve_drained(final, _contained_flush(self.packer))
+        self.packer.clear_flush_causes()
+
+    def _resolve_drained(self, final: bool, flush_error) -> None:
+        """Post-flush resolution for THIS session's model: finalize what
+        completed, fail the videos whose rows a co-packed batch failure
+        lost (each wearing only its own buckets' recorded causes)."""
         packer = self.packer
-        flush_error = None
-        try:
-            # tail-batch device failures are contained per bucket inside
-            # flush() and surface as flush_causes on the drained victims;
-            # this except is a safety net for non-dispatch failures
-            packer.flush()
-        except KeyboardInterrupt:
-            raise
-        except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point
-            flush_error = e
         self.emit_completed(reap_limit=0 if final else 1)
-        for asm in packer.drain_incomplete():
+        for asm in packer.drain_incomplete(model=self.model):
             # rows lost to a failed co-packed batch (mid-run, at a stale
             # flush, or at this flush): fail each contributing video so it
             # lands in the failure manifest (DeviceError is transient — a
@@ -972,7 +1022,6 @@ class PackedSession:
                 f"this video's clips resolved{cause}; rerun with "
                 "--retry_failed"))
             self._forget_video(asm.video)
-        packer.clear_flush_causes()
 
     # --- shared accounting ----------------------------------------------------
 
@@ -990,6 +1039,271 @@ class PackedSession:
     def _forget_video(self, path: str) -> None:
         if self._forget:
             self.packer.forget(path)
+
+
+def _contained_flush(packer):
+    """Flush ``packer``, returning (not raising) any non-dispatch failure.
+
+    Tail-batch device failures are contained per bucket inside ``flush()``
+    and surface as flush_causes on the drained victims; this wrapper is the
+    safety net for failures outside that containment."""
+    try:
+        packer.flush()
+        return None
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point
+        return e
+
+
+# Flags that shape ONE model's windows/geometry/streams: reset to their
+# dataclass defaults for a co-loaded serving model, so each model resolves
+# its own reference behavior (an i3d daemon's resolved stack_size=64, or a
+# primary-only --extraction_fps that r21d would reject outright, must not
+# leak into a co-resident model's derived config).
+_MODEL_SCOPED_FIELDS = ("stack_size", "step_size", "streams",
+                        "extraction_fps", "side_size",
+                        "resize_to_smaller_edge", "i3d_pre_crop_size",
+                        "i3d_crop_size")
+
+
+def derive_model_config(cfg: ExtractionConfig, model: str) -> ExtractionConfig:
+    """The config a co-loaded serving model (``--serve_models``) runs under.
+
+    Same flag surface as the daemon's primary config, with the model-scoped
+    fields (``_MODEL_SCOPED_FIELDS``) RESET to their defaults so each model
+    resolves its own reference behavior. Explicit per-model overrides
+    therefore apply only to the primary ``--feature_type``; co-loaded
+    models run their reference geometry."""
+    import dataclasses
+
+    defaults = {f.name: f.default for f in dataclasses.fields(cfg)
+                if f.name in _MODEL_SCOPED_FIELDS}
+    return cfg.replace(feature_type=model, **defaults)
+
+
+class MultiModelSessions:
+    """Co-resident models on one mesh: per-model :class:`PackedSession`\\ s
+    over ONE shared ``(model, geometry)``-keyed packer (docs/serving.md).
+
+    The serving daemon's session layer (ROADMAP item 2): the primary
+    extractor (already constructed, run resources open) is joined by
+    lazily-constructed extractors for each co-loaded feature type — built on
+    first traffic, so a daemon configured for three models but seeing two
+    pays nothing for the third — all sharing the primary's mesh runner, host
+    staging ring (its geometry cap scaled by the loaded model count), async
+    output writer, decode pool (rerouted per path to the owning model's host
+    transform), service clock, and feature-cache store. Outputs, manifests,
+    and cache fingerprints stay per model: each extractor keeps its own
+    ``<output>/<feature_type>/`` tree, so a two-model daemon's outputs are
+    byte-identical to the corresponding single-model daemons'.
+
+    Dispatch interleaving lives in the shared packer (round-robin across
+    models whenever several have ready batches); arrival-order interleaving
+    comes from the tenant scheduler, which stays global across tenants —
+    fairness is never siloed per model.
+    """
+
+    def __init__(self, primary: Extractor, models: Sequence[str],
+                 on_done=None, on_failed=None, factory=None,
+                 primary_spec=None):
+        from ..parallel.packer import CorpusPacker
+
+        self.primary = primary
+        self.models = tuple(models)
+        self._on_done = on_done
+        self._on_failed = on_failed
+        self._factory = factory if factory is not None else self._build_real
+        if len(self.models) > 1:
+            # each co-resident model brings its own working set of batch
+            # geometries — scale the shared ring's cap so model B's buckets
+            # don't thrash model A's staged buffers out of the ring
+            primary._staging = HostStagingRing(
+                depth=max(primary.cfg.prefetch_depth, 1) + 2,
+                on_wait=primary._transfer_wait,
+                max_geometries=(HostStagingRing.DEFAULT_MAX_GEOMETRIES
+                                * len(self.models)))
+        self.packer = CorpusPacker(
+            wait=primary._wait, clock=primary.clock,
+            flush_age=primary.cfg.pack_flush_age, staging=primary._staging)
+        self._extractors: Dict[str, Extractor] = {
+            primary.feature_type: primary}
+        # path → extractor, for the shared decode pool's router; written on
+        # the daemon thread at schedule time, read by pool workers at decode
+        # start (schedule() happens-before the worker thread starts)
+        self._ex_for_path: Dict[str, Extractor] = {}
+        self._pool = None  # a pool this layer created (primary had none)
+        # the daemon validates the primary spec BEFORE opening run resources
+        # (so a spec-less config errors without leaking pool threads) and
+        # passes it via primary_spec; the re-check here covers callers that
+        # construct this layer directly
+        spec = primary_spec if primary_spec is not None \
+            else primary.pack_spec()
+        if spec is None:
+            raise ValueError(
+                f"--serve needs a packing path, but {primary.feature_type} "
+                "has none under this config (--show_pred and the "
+                "single-clip frame-sharded flow sandwich are batch-only)")
+        self._sessions: Dict[str, PackedSession] = {
+            primary.feature_type: PackedSession(
+                primary, spec, on_done=on_done, on_failed=on_failed,
+                forget_completed=True, packer=self.packer,
+                model=primary.feature_type)}
+        if primary._decode_pool is not None and len(self.models) > 1:
+            primary._decode_pool.set_opener(self._open_routed)
+
+    # --- lazy model construction ---------------------------------------------
+
+    def _build_real(self, model: str) -> Extractor:
+        from . import get_extractor
+
+        return get_extractor(derive_model_config(self.primary.cfg, model))
+
+    def extractor(self, model: str) -> Extractor:
+        """The model's extractor, constructed (and wired into the shared
+        resources) on first use. Raises on an unknown model name or a
+        construction failure — the daemon turns that into a clean per-video
+        failure, never a crash."""
+        ex = self._extractors.get(model)
+        if ex is not None:
+            return ex
+        if model not in self.models:
+            raise ValueError(f"feature_type {model!r} is not loaded "
+                             f"(serving: {', '.join(self.models)})")
+        primary = self.primary
+        with _shared_construction(runner=primary.runner,
+                                  staging=primary._staging,
+                                  cache=primary._cache):
+            ex = self._factory(model)
+        ex.clock = primary.clock
+        ex._writer = primary._writer
+        ex._decode_pool = (self._shared_pool()
+                           if ex.uses_frame_stream else None)
+        spec = ex.pack_spec()
+        if spec is None:
+            raise ValueError(
+                f"feature_type {model!r} has no packing path under this "
+                "config; it cannot be served")
+        self._sessions[model] = PackedSession(
+            ex, spec, on_done=self._on_done, on_failed=self._on_failed,
+            forget_completed=True, packer=self.packer, model=model)
+        self._extractors[model] = ex
+        return ex
+
+    def peek_extractor(self, model: str) -> Optional[Extractor]:
+        """The model's extractor if already constructed, else None (never
+        triggers construction — cleanup paths must stay cheap)."""
+        return self._extractors.get(model)
+
+    def session(self, model: str) -> PackedSession:
+        self.extractor(model)
+        return self._sessions[model]
+
+    # --- shared decode pool ----------------------------------------------------
+
+    @property
+    def decode_pool(self):
+        return self.primary._decode_pool or self._pool
+
+    def _shared_pool(self):
+        """The one decode pool all frame-stream models share (None when the
+        config runs inline decode). Created here when the primary model does
+        not consume the frame stream but a co-loaded model does."""
+        if self.primary._decode_pool is not None:
+            return self.primary._decode_pool
+        if self._pool is None and self.primary._decode_workers > 1:
+            self._pool = DecodePrefetcher(self._open_routed,
+                                          self.primary._decode_workers)
+        return self._pool
+
+    def _open_routed(self, path: str):
+        """Pool opener: decode ``path`` with its owning model's transform."""
+        ex = self._ex_for_path.get(path, self.primary)
+        return ex._open_inline(path)
+
+    def schedule_decode(self, path: str, model: str) -> None:
+        """Prefetch-hint ``path`` on the shared pool under its model's
+        decode transform. Hints never CONSTRUCT a model (weights + compile
+        on the daemon thread would stall the currently-popped job): a
+        not-yet-built model's jobs simply decode unhinted until their first
+        pop pays construction. No-op for non-frame-stream models."""
+        ex = self._extractors.get(model)
+        if ex is None:
+            return
+        pool = ex._decode_pool
+        if pool is None or not ex.uses_frame_stream:
+            return
+        self._ex_for_path[path] = ex
+        pool.schedule(path)
+
+    def release_decode(self, path: str) -> None:
+        """Cancel/forget a path's decode on the shared pool (idempotent)."""
+        self._ex_for_path.pop(path, None)
+        pool = self.decode_pool
+        if pool is not None:
+            pool.release(path)
+
+    # --- session routing -------------------------------------------------------
+
+    def ingest(self, path: str, model: str, retries=None) -> None:
+        self.session(model).ingest(path, retries=retries)
+
+    def fail(self, path: str, model: str, e: BaseException) -> None:
+        self.session(model).fail(path, e)
+
+    def emit_completed(self, reap_limit: int = 1) -> None:
+        for s in list(self._sessions.values()):
+            s.emit_completed(reap_limit=reap_limit)
+
+    def drain(self, final: bool = False) -> None:
+        """Flush the shared packer ONCE (interleaved round-robin across
+        models), then resolve each model's completions and drained victims
+        — healthy models' videos finish even when one model's bucket died."""
+        flush_error = _contained_flush(self.packer)
+        for s in list(self._sessions.values()):
+            s._resolve_drained(final, flush_error)
+        self.packer.clear_flush_causes()
+
+    # --- aggregate accounting --------------------------------------------------
+    # dict(self._extractors) snapshots atomically (C-level, under the GIL):
+    # the serve socket's stats op reads these from the API thread while the
+    # daemon thread lazily registers a new model — Python-level iteration
+    # over the live dict could raise "changed size during iteration"
+
+    @property
+    def ok(self) -> int:
+        return sum(ex._ok for ex in dict(self._extractors).values())
+
+    @property
+    def failures(self) -> int:
+        return sum(ex._failures for ex in dict(self._extractors).values())
+
+    def pending_writes(self) -> int:
+        return sum(len(ex._pending_writes)
+                   for ex in dict(self._extractors).values())
+
+    def model_counts(self) -> Dict[str, Dict[str, int]]:
+        """Per-model completion counters for the serve stats op."""
+        return {m: {"videos_ok": ex._ok, "videos_failed": ex._failures}
+                for m, ex in sorted(dict(self._extractors).items())}
+
+    def close(self) -> None:
+        """Tear down: the primary closes the shared pool + writer (draining
+        every model's queued writes), then each co-loaded extractor accounts
+        its own abandoned handles and prunes its own failure manifest."""
+        primary = self.primary
+        secondaries = [ex for ex in self._extractors.values()
+                       if ex is not primary]
+        for ex in secondaries:
+            ex._decode_pool = None  # shared (or never owned): primary closes
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+        primary._close_run_resources()
+        for ex in secondaries:
+            ex._writer = None  # the shared writer is closed and drained
+            ex._reap_abandoned_writes()
+            ex._prune_succeeded(ex._succeeded)
 
 
 def pad_batch(arr: np.ndarray, batch_size: int) -> np.ndarray:

@@ -13,7 +13,7 @@ head of video N+1. Per-clip results scatter back to per-video assembly
 buffers (:class:`..io.output.FeatureAssembly`) that the run loop flushes
 through the output writer as each video's last clip lands.
 
-Three generalizations beyond the original RGB-only packer:
+Four generalizations beyond the original RGB-only packer:
 
 - **collate seam** — a :class:`PackSpec` may supply ``collate`` to build the
   device batch itself (and decide how many queued slots actually fit). The
@@ -30,6 +30,19 @@ Three generalizations beyond the original RGB-only packer:
   and an anti-starvation flush dispatches a bucket's partial queue once
   ``flush_age`` videos have finished while it sat waiting — a rare geometry
   cannot strand its videos until corpus end.
+- **co-resident models** — the bucket key is really ``(model, geometry)``:
+  :meth:`CorpusPacker.register_model` adds further :class:`PackSpec`\\ s (one
+  per feature type, each with its own step callable and batch size) to one
+  packer, so a mixed resnet50/i3d/vggish request stream feeds ONE mesh and
+  the device never drains while *any* model has backlog (ROADMAP item 2 —
+  a model is "just" another bucket dimension). Whenever more than one
+  model's queues are ready to dispatch (the corpus/idle flush, the
+  anti-starvation flush, collate leftovers), batches interleave round-robin
+  across models so no single model's backlog monopolizes the device;
+  in-stream, arrival order already interleaves models because the serving
+  scheduler pops videos tenant-fair, not model-grouped. One-batch-in-flight
+  overlap, flush-age aging, occupancy stats, and slot-level fault
+  attribution all hold per ``(model, geometry)`` key unchanged.
 
 Threading model — deliberately single-threaded: the packed run loop (one
 consumer) pulls each video's clip stream in corpus order and calls
@@ -215,9 +228,18 @@ class CorpusPacker:
     corpus end.
     """
 
-    def __init__(self, spec: PackSpec, wait: Callable[[Any], np.ndarray],
+    def __init__(self, spec: Optional[PackSpec] = None,
+                 wait: Callable[[Any], np.ndarray] = np.asarray,
                  clock=None, flush_age: int = 0, staging=None):
-        self._spec = spec
+        # model name -> PackSpec. Single-model callers (the batch loop, the
+        # engine tests) pass one spec, registered under None; the multi-model
+        # serving layer constructs spec-less and register_model()s each
+        # feature type — every internal key is (model, clip shape) either way
+        self._specs: Dict[Optional[str], PackSpec] = {}
+        if spec is not None:
+            self._specs[None] = spec
+        self._video_model: Dict[str, Optional[str]] = {}
+        self._rr_last: Optional[str] = None  # last model dispatched (RR seed)
         self._wait = wait
         self._clock = clock  # optional StageClock: packed_slots/packed_clips units
         self._flush_age = flush_age
@@ -252,18 +274,50 @@ class CorpusPacker:
         # under (cause attribution for stale-flush failures)
         self._video_keys: Dict[str, set] = {}
 
+    # --- model registry ------------------------------------------------------
+
+    def register_model(self, model: Optional[str], spec: PackSpec) -> None:
+        """Co-locate another feature type's spec on this packer.
+
+        Each model keeps its own step callable, batch size, and
+        ``(model, geometry)`` bucket keys; nothing co-packs ACROSS models
+        (their rows are different programs) — co-residency keeps the device
+        fed when any one model's queue drains."""
+        self._specs[model] = spec
+
+    @property
+    def models(self) -> Tuple[Optional[str], ...]:
+        return tuple(self._specs)
+
+    def _spec_for(self, key: tuple) -> PackSpec:
+        return self._specs[key[0]]
+
+    @staticmethod
+    def _bucket_name(key: tuple) -> str:
+        model, shape = key
+        dims = "x".join(str(d) for d in shape)
+        return dims if model is None else f"{model}:{dims}"
+
     # --- per-video lifecycle -------------------------------------------------
 
-    def begin(self, path: str, info: dict) -> None:
-        """Open a fresh attempt for ``path`` (replacing any failed prior one)."""
+    def begin(self, path: str, info: dict,
+              model: Optional[str] = None) -> None:
+        """Open a fresh attempt for ``path`` (replacing any failed prior one).
+
+        ``model`` routes the video's clips to that registered spec's
+        ``(model, geometry)`` buckets; None is the single-spec default."""
+        if model not in self._specs:
+            raise KeyError(f"model {model!r} is not registered with this "
+                           f"packer (have: {sorted(map(str, self._specs))})")
         self.discard(path)
+        self._video_model[path] = model
         self._open[path] = FeatureAssembly(path, info)
 
     def add(self, path: str, clip: np.ndarray) -> None:
-        """Queue one clip; dispatches a device batch when its shape queue fills."""
+        """Queue one clip; dispatches device batches when queues fill."""
         asm = self._open[path]
         slot = _Slot(asm, asm.reserve(), clip)
-        key = clip.shape
+        key = (self._video_model[path], clip.shape)
         self._video_keys.setdefault(path, set()).add(key)
         queue = self._pending.setdefault(key, [])
         # a bucket receiving slots is being fed, not stranded: age counts
@@ -271,11 +325,55 @@ class CorpusPacker:
         # filling common bucket is never padded-flushed mid-corpus
         self._queue_born[key] = self._videos_finished
         queue.append(slot)
-        # a collate may consume fewer than batch_size slots per dispatch
-        # (flow windows burn a frame position per video boundary), so keep
-        # dispatching while the queue stays full
-        while len(queue) >= self._spec.batch_size:
-            self._dispatch(key)
+        self._pump()
+
+    def _full(self, key: tuple) -> bool:
+        queue = self._pending.get(key)
+        return bool(queue) and len(queue) >= self._spec_for(key).batch_size
+
+    def _pump(self) -> None:
+        """Dispatch every full queue, one batch per key per round,
+        round-robin across models between rounds.
+
+        Single-model this is the old ``while full: dispatch`` loop (a
+        collate may consume fewer than batch_size slots per dispatch — flow
+        windows burn a frame position per video boundary — so the queue can
+        stay full across rounds). Multi-model, whenever several models have
+        full queues at once, the round order starts after the last-served
+        model so one model's deep backlog cannot dispatch twice before
+        another model's ready batch dispatches once."""
+        while True:
+            ready = [k for k in self._pending if self._full(k)]
+            if not ready:
+                return
+            for key in self._one_per_model(ready):
+                if self._full(key):
+                    self._dispatch(key)
+
+    def _rr_order(self, keys: List[tuple]) -> List[tuple]:
+        """``keys`` ordered round-robin by model starting after the last
+        dispatched model (deterministic string order within a model)."""
+        models = sorted({k[0] for k in keys}, key=str)
+        start = 0
+        if self._rr_last is not None:
+            for i, m in enumerate(models):
+                if str(m) > str(self._rr_last):
+                    start = i
+                    break
+        order = {m: i for i, m in enumerate(models[start:] + models[:start])}
+        return sorted(keys, key=lambda k: (order[k[0]], str(k)))
+
+    def _one_per_model(self, ready: List[tuple]) -> List[tuple]:
+        """One ready key PER MODEL, round-robin ordered — the dispatch round
+        shape: with several models ready, each round serves each model one
+        batch, so no model's multi-bucket backlog dispatches twice before
+        another model's ready batch dispatches once."""
+        out, seen = [], set()
+        for key in self._rr_order(ready):
+            if key[0] not in seen:
+                seen.add(key[0])
+                out.append(key)
+        return out
 
     def finish(self, path: str) -> None:
         """Mark ``path``'s stream complete; it finalizes once all rows land."""
@@ -295,6 +393,7 @@ class CorpusPacker:
         tests/test_service.py pins this)."""
         self.video_clips.pop(path, None)
         self._video_keys.pop(path, None)
+        self._video_model.pop(path, None)
 
     def discard(self, path: str) -> None:
         """Drop every trace of ``path``'s current attempt (failure/retry).
@@ -306,6 +405,7 @@ class CorpusPacker:
         asm = self._open.pop(path, None)
         self.video_clips.pop(path, None)
         self._video_keys.pop(path, None)
+        self._video_model.pop(path, None)
         self._finished = [a for a in self._finished if a.video != path]
         if asm is None:
             return
@@ -315,11 +415,12 @@ class CorpusPacker:
     # --- dispatch ------------------------------------------------------------
 
     def _dispatch(self, key: tuple) -> None:
+        spec = self._spec_for(key)
         queue = self._pending[key]
-        batch_size = self._spec.batch_size
+        batch_size = spec.batch_size
         candidates = queue[:batch_size]
-        if self._spec.collate is not None:
-            batch, n_used, row_of = self._spec.collate(
+        if spec.collate is not None:
+            batch, n_used, row_of = spec.collate(
                 [s.clip for s in candidates],
                 [(id(s.assembly), s.idx) for s in candidates])
             slots = candidates[:n_used]
@@ -330,7 +431,8 @@ class CorpusPacker:
             batch = self._stage_batch([s.clip for s in slots], batch_size)
             row_of = range(len(slots))
         self._scatter_inflight(key)  # resolve this bucket's batch k first
-        out = self._spec.step(batch)
+        out = spec.step(batch)
+        self._rr_last = key[0]  # round-robin seed: the model just served
         if self._staging is not None:
             # no-op for batches the ring does not own (collate specs commit
             # their own buffers at device_put time, inside step)
@@ -380,25 +482,48 @@ class CorpusPacker:
         fill their own batches."""
         if not self._flush_age:
             return
-        for key, queue in list(self._pending.items()):
-            if not queue:
-                continue
-            if self._videos_finished - self._queue_born[key] < self._flush_age:
+        stale = [key for key, queue in self._pending.items()
+                 if queue and (self._videos_finished - self._queue_born[key]
+                               >= self._flush_age)]
+        if not stale:
+            return
+        failed = set()
+        while True:
+            # same one-batch-per-model rounds as _pump/flush: several
+            # models' stale buckets interleave instead of one model
+            # draining its whole backlog first
+            ready = [k for k in stale
+                     if k not in failed and self._pending.get(k)]
+            if not ready:
+                break
+            for key in self._one_per_model(ready):
+                if not self._pending.get(key):
+                    continue
+                try:
+                    self._dispatch(key)
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — fault-barrier: the stale-flush arm of the per-video isolation point — the flushed batch may hold ZERO slots of the video whose finish() triggered it, so letting this escape would retry/fail the wrong (healthy) video; victims resolve via drain_incomplete with this cause
+                    self._record_stale_failure(key, e)
+                    failed.add(key)
+        for key in stale:
+            if key in failed:
                 continue
             try:
-                while queue:
-                    self._dispatch(key)
                 self._scatter_inflight(key)  # rare bucket: complete now
             except KeyboardInterrupt:
                 raise
-            except Exception as e:  # noqa: BLE001 — fault-barrier: the stale-flush arm of the per-video isolation point — the flushed batch may hold ZERO slots of the video whose finish() triggered it, so letting this escape would retry/fail the wrong (healthy) video; victims resolve via drain_incomplete with this cause
-                msg = (f"anti-starvation flush of bucket "
-                       f"{'x'.join(str(d) for d in key)} failed: {e}")
-                self.flush_errors.setdefault(key, []).append(msg)
-                print(f"[pack] {msg}; its videos will be failed (retryable) "
-                      "when the corpus drains", file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the scatter arm of the stale flush — same victim attribution as the dispatch arm above
+                self._record_stale_failure(key, e)
                 continue
             self._bucket_stats[key]["stale_flushes"] += 1
+
+    def _record_stale_failure(self, key: tuple, e: BaseException) -> None:
+        msg = (f"anti-starvation flush of bucket "
+               f"{self._bucket_name(key)} failed: {e}")
+        self.flush_errors.setdefault(key, []).append(msg)
+        print(f"[pack] {msg}; its videos will be failed (retryable) "
+              "when the corpus drains", file=sys.stderr)
 
     def flush(self) -> None:
         """Dispatch every partial shape queue (padded) and resolve in-flight.
@@ -407,37 +532,69 @@ class CorpusPacker:
         abort the other buckets' dispatch/scatter — healthy buckets still
         resolve, and the failed bucket's contributors drain incomplete
         wearing only their own bucket's recorded cause.
+
+        Multi-model packers drain ROUND-ROBIN across models, one batch per
+        key per round, so one model's deep backlog cannot monopolize the
+        device while another model's ready tail waits.
         """
         keys = set(self._pending) | set(self._inflight)
-        for key in sorted(keys, key=str):
-            try:
-                while self._pending.get(key):
+        failed = set()
+        while True:
+            ready = [k for k in keys
+                     if k not in failed and self._pending.get(k)]
+            if not ready:
+                break
+            for key in self._one_per_model(ready):
+                if not self._pending.get(key):
+                    continue
+                try:
                     self._dispatch(key)
+                except KeyboardInterrupt:
+                    raise
+                except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point — a tail batch holds rows of whichever videos' slots it packed, so letting one bucket's failure escape would fail every other bucket's (healthy) pending videos with the wrong cause; victims resolve via drain_incomplete with this cause
+                    self._record_flush_failure(key, e)
+                    failed.add(key)
+        for key in sorted(keys - failed, key=str):
+            try:
                 self._scatter_inflight(key)
             except KeyboardInterrupt:
                 raise
-            except Exception as e:  # noqa: BLE001 — fault-barrier: the corpus-flush arm of the per-video isolation point — a tail batch holds rows of whichever videos' slots it packed, so letting one bucket's failure escape would fail every other bucket's (healthy) pending videos with the wrong cause; victims resolve via drain_incomplete with this cause
-                msg = (f"corpus flush of bucket "
-                       f"{'x'.join(str(d) for d in key)} failed: {e}")
-                self.flush_errors.setdefault(key, []).append(msg)
-                print(f"[pack] {msg}; its videos will be failed (retryable)",
-                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001 — fault-barrier: the scatter arm of the corpus flush — same per-bucket containment as the dispatch arm above
+                self._record_flush_failure(key, e)
+
+    def _record_flush_failure(self, key: tuple, e: BaseException) -> None:
+        msg = f"corpus flush of bucket {self._bucket_name(key)} failed: {e}"
+        self.flush_errors.setdefault(key, []).append(msg)
+        print(f"[pack] {msg}; its videos will be failed (retryable)",
+              file=sys.stderr)
 
     # --- results -------------------------------------------------------------
 
-    def pop_completed(self) -> List[FeatureAssembly]:
-        """Assemblies whose stream finished AND whose every row has landed."""
-        done = [a for a in self._finished if a.complete]
+    def pop_completed(self, model: Optional[str] = None
+                      ) -> List[FeatureAssembly]:
+        """Assemblies whose stream finished AND whose every row has landed.
+
+        ``model`` scopes the pop to one registered model's videos (each
+        multi-model session finalizes with its OWN spec); the single-spec
+        default None matches everything a single-spec packer holds."""
+        done = [a for a in self._finished
+                if a.complete and self._video_model.get(a.video) == model]
         if done:
-            self._finished = [a for a in self._finished if not a.complete]
+            popped = set(map(id, done))
+            self._finished = [a for a in self._finished
+                              if id(a) not in popped]
         return done
 
-    def drain_incomplete(self) -> List[FeatureAssembly]:
+    def drain_incomplete(self, model: Optional[str] = None
+                         ) -> List[FeatureAssembly]:
         """Finished-stream videos still missing rows after :meth:`flush` —
         their slots were lost to a co-packed batch's device failure; the run
-        loop fails them explicitly so they land in the failure manifest."""
-        out = [a for a in self._finished if not a.complete]
-        self._finished = [a for a in self._finished if a.complete]
+        loop fails them explicitly so they land in the failure manifest.
+        ``model`` scopes the drain exactly like :meth:`pop_completed`."""
+        out = [a for a in self._finished
+               if not a.complete and self._video_model.get(a.video) == model]
+        drained = set(map(id, out))
+        self._finished = [a for a in self._finished if id(a) not in drained]
         return out
 
     def clear_flush_causes(self) -> None:
@@ -487,8 +644,7 @@ class CorpusPacker:
         out: Dict[str, Dict[str, float]] = {}
         for key, live in sorted(dict(self._bucket_stats).items(), key=str):
             s = dict(live)
-            name = "x".join(str(d) for d in key)
-            out[name] = {
+            out[self._bucket_name(key)] = {
                 "real_slots": s["real_slots"],
                 "dispatched_slots": s["dispatched_slots"],
                 "occupancy": round(
@@ -497,3 +653,24 @@ class CorpusPacker:
                 "stale_flushes": s["stale_flushes"],
             }
         return out
+
+    def model_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-model occupancy rollup of :meth:`bucket_stats` (the serve
+        stats op's ``packing.models`` section — operators watch one model's
+        queue starving without decoding bucket names). Same atomic-snapshot
+        discipline: safe from the API thread."""
+        agg: Dict[str, Dict[str, int]] = {}
+        for key, live in sorted(dict(self._bucket_stats).items(), key=str):
+            s = dict(live)
+            name = key[0] if key[0] is not None else "default"
+            a = agg.setdefault(name, {"real_slots": 0, "dispatched_slots": 0,
+                                      "stale_flushes": 0})
+            a["real_slots"] += s["real_slots"]
+            a["dispatched_slots"] += s["dispatched_slots"]
+            a["stale_flushes"] += s["stale_flushes"]
+        return {
+            name: {**a, "occupancy":
+                   round(a["real_slots"] / a["dispatched_slots"], 4)
+                   if a["dispatched_slots"] else 0.0}
+            for name, a in agg.items()
+        }

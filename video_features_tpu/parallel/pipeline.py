@@ -148,6 +148,16 @@ class DecodePrefetcher:
         """Current concurrency target (also the run loops' schedule window)."""
         return self._workers
 
+    def set_opener(self, open_fn: Callable) -> None:
+        """Replace the per-path decode callable.
+
+        The multi-model serving layer (``extractors/base.py``) shares ONE
+        pool across co-resident models and reroutes it through a path→model
+        router so each scheduled video decodes with its own model's host
+        transform. Must be called before any :meth:`schedule` whose decode
+        should route — workers read the opener at decode start."""
+        self._open = open_fn
+
     def resize(self, workers: int) -> None:
         """Grow or shrink the concurrent-decode budget without a restart.
 
@@ -353,11 +363,17 @@ class HostStagingRing:
     ``max_geometries × depth`` buffers instead of growing forever — the ring
     analogue of ``packer.forget``'s long-run bound. A corpus cycling through
     more concurrent geometries than the cap just re-allocates for the
-    evicted ones (correctness unaffected).
+    evicted ones (correctness unaffected). ``DEFAULT_MAX_GEOMETRIES`` is the
+    single-model budget; a multi-model daemon (``--serve_models``) scales it
+    by the loaded model count, since each co-resident model brings its own
+    working set of batch geometries and would otherwise thrash the shared
+    ring's eviction.
     """
 
+    DEFAULT_MAX_GEOMETRIES = 8
+
     def __init__(self, depth: int = 3, on_wait: Optional[Callable] = None,
-                 max_geometries: int = 8):
+                 max_geometries: int = DEFAULT_MAX_GEOMETRIES):
         if depth < 1:
             raise ValueError("staging ring depth must be >= 1")
         if max_geometries < 1:

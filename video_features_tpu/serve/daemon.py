@@ -36,6 +36,18 @@ same bytes run ONE extraction, waiters replay from the fresh entry with
 quota/fairness charged per waiter, and a leader failure re-enqueues the
 waiters (next replay leads on its own retry budget) instead of charging a
 neighbour's fault to their breakers.
+
+With ``--serve_models`` (ROADMAP item 2) several feature types co-reside on
+ONE mesh: requests pick a model via their ``feature_type`` key (admission
+validates it against the loaded set and rejects unknown models with a clean
+record), each model's extractor is constructed lazily on first traffic
+sharing the primary's run resources (:class:`..extractors.base.
+MultiModelSessions`), and the packer interleaves dispatch round-robin across
+models — mixed traffic never drains the device while ANY model has backlog.
+Tenant fairness and deadlines stay GLOBAL across models (a tenant cannot
+dodge its weight by spreading over models), breakers stay per tenant across
+models, and a graceful drain finishes every admitted model's in-flight
+batches.
 """
 
 from __future__ import annotations
@@ -49,8 +61,10 @@ import time
 from typing import Dict, Optional
 
 from ..cache import InflightCoalescer
-from ..extractors.base import PackedSession
+from ..config import resolve_model_defaults
+from ..extractors.base import MultiModelSessions, derive_model_config
 from ..io.output import (
+    feature_output_dir,
     load_done_set,
     request_result_path,
     write_request_result,
@@ -71,7 +85,8 @@ from .scheduler import RequestQueue
 class ExtractionService:
     """One extractor serving a live request stream until drained."""
 
-    def __init__(self, extractor, poll_interval: float = 0.05):
+    def __init__(self, extractor, poll_interval: float = 0.05,
+                 factory=None):
         cfg = extractor.cfg
         self.ex = extractor
         self.cfg = cfg
@@ -82,16 +97,33 @@ class ExtractionService:
                 "none under this config (--show_pred and the single-clip "
                 "frame-sharded flow sandwich are batch-only)")
         self.spec = spec
+        # co-resident model set (--serve_models): the primary first (the
+        # default for requests without a feature_type), extras deduped in
+        # flag order. Each extra's DERIVED config (its own reference
+        # stack/step defaults) must validate NOW — a daemon that would die
+        # constructing model B on its first request should die at startup
+        extras = []
+        for m in cfg.serve_models or ():
+            if m != cfg.feature_type and m not in extras:
+                extras.append(m)
+        self.models = (cfg.feature_type, *extras)
+        for m in extras:
+            resolve_model_defaults(derive_model_config(cfg, m)).validate()
         self._poll = poll_interval
         # the service clock runs for the daemon's lifetime: decode/device
         # attribution feeds the autoscaler and the stats op regardless of
         # VFT_METRICS
         extractor.clock = StageClock()
         extractor._open_run_resources()
-        self.session = PackedSession(
-            extractor, spec, on_done=self._video_done,
-            on_failed=self._video_failed, forget_completed=True)
-        self.packer = self.session.packer
+        # ``factory(model) -> Extractor`` overrides lazy co-model
+        # construction (tests wire toy models); the default builds the real
+        # extractor for the derived config, sharing the primary's mesh
+        self.sessions = MultiModelSessions(
+            extractor, self.models, on_done=self._video_done,
+            on_failed=self._video_failed, factory=factory,
+            primary_spec=spec)
+        self.session = self.sessions
+        self.packer = self.sessions.packer
         self.queue = RequestQueue(default_quota=cfg.tenant_quota)
         self.breaker = TenantBreaker(cfg.tenant_max_failures)
         self.notify_dir = cfg.notify_dir or os.path.join(
@@ -99,8 +131,9 @@ class ExtractionService:
         self._autoscaler = (DecodeAutoscaler()
                             if cfg.decode_workers == 0 else None)
         self._as_snapshot = (time.perf_counter(), 0.0, 0, 0)
-        self._done_set = (load_done_set(extractor.output_dir)
-                          if cfg.resume else set())
+        # --resume strips already-done videos at admission, per model (each
+        # feature type keeps its own output subtree and done-manifest)
+        self._done_sets: Dict[str, frozenset] = {}
         self._lock = threading.RLock()
         self._requests: Dict[str, ServiceRequest] = {}
         self._jobs: Dict[str, object] = {}  # abspath -> in-flight VideoJob
@@ -111,6 +144,9 @@ class ExtractionService:
         self._hup = threading.Event()
         self._idle_since: Optional[float] = None
         self._completed_requests = 0
+        # terminal failures with no extractor to account them (a co-loaded
+        # model whose lazy construction failed) — the exit code includes them
+        self._service_failures = 0
         self._closed = False
         if cfg.spool_dir:
             self._load_tenants_config(initial=True)
@@ -124,6 +160,17 @@ class ExtractionService:
             raise RequestRejected("service is draining; resubmit after "
                                   "restart")
         request = parse_request(payload, request_id=request_id, source=source)
+        # resolve the model at admission: the daemon's default when omitted,
+        # and ANY named model must be in the loaded set — an unknown model is
+        # a clean synchronous rejection (record written where the submitter
+        # looks), never a daemon crash or a silent terminal failure
+        ft = request.feature_type or self.cfg.feature_type
+        if ft not in self.models:
+            raise RequestRejected(
+                f"feature_type {ft!r} is not loaded (serving: "
+                f"{', '.join(self.models)}); start the daemon with "
+                "--serve_models to co-load it")
+        request.feature_type = ft
         with self._lock:
             if request.request_id in self._requests:
                 raise RequestRejected(
@@ -135,11 +182,25 @@ class ExtractionService:
                     "failures); fix the inputs and SIGHUP-reload")
             to_queue = request.videos
             resumed = ()
-            if self._done_set:
+            done = self._resume_done(ft)
+            if done:
                 resumed = tuple(v for v in request.videos
-                                if os.path.abspath(v) in self._done_set)
+                                if os.path.abspath(v) in done)
                 to_queue = tuple(v for v in request.videos
-                                 if os.path.abspath(v) not in self._done_set)
+                                 if os.path.abspath(v) not in done)
+            # the scheduler rejects duplicates against its QUEUED set; a
+            # path that was already popped (ingested, rows/writes pending)
+            # is only visible here — without this check a resubmission
+            # (same or another model) would overwrite _jobs[path] and
+            # packer.begin() would discard the first attempt's in-flight
+            # assembly, silently losing the original request's video
+            inflight = [v for v in to_queue
+                        if os.path.abspath(v) in self._jobs]
+            if inflight:
+                raise RequestRejected(
+                    f"video(s) currently in flight under a live request: "
+                    f"{', '.join(sorted(inflight)[:3])}"
+                    + ("…" if len(inflight) > 3 else ""))
             if to_queue:
                 self.queue.submit(request, videos=to_queue)
             self._requests[request.request_id] = request
@@ -150,6 +211,17 @@ class ExtractionService:
                   + (f", {len(resumed)} resumed" if resumed else "") + ")")
             self._maybe_finish_request(request)
         return request
+
+    def _resume_done(self, feature_type: str) -> frozenset:
+        """The model's done-manifest set (empty without --resume)."""
+        if not self.cfg.resume:
+            return frozenset()
+        done = self._done_sets.get(feature_type)
+        if done is None:
+            done = frozenset(load_done_set(feature_output_dir(
+                self.cfg.output_path, feature_type)))
+            self._done_sets[feature_type] = done
+        return done
 
     def reject(self, request_id: str, reason: str, source: str = "api",
                payload=None) -> None:
@@ -177,7 +249,14 @@ class ExtractionService:
         if self._hup.is_set():
             self._hup.clear()
             self.reload()
-        job = self.queue.next_job()
+        with self._lock:
+            # pop + register atomically: between leaving the scheduler's
+            # queued set and appearing in _jobs, a resubmission of the same
+            # path would pass BOTH duplicate checks (service lock → queue
+            # lock here matches the submit path's ordering)
+            job = self.queue.next_job()
+            if job is not None:
+                self._jobs[job.path] = job
         if job is None:
             # resolve outstanding writes so finished videos complete their
             # requests even while no new work arrives
@@ -196,22 +275,46 @@ class ExtractionService:
             return False
         self._idle_since = None
         path = job.path
+        model = job.feature_type or self.cfg.feature_type
         tenant = job.request.tenant
         if self.breaker.tripped(tenant):
             # raced a trip while queued (requeue after drain_tenant)
             self._fail_job_fast(job, "breaker opened while queued")
             return True
-        with self._lock:
-            self._jobs[path] = job
-        if self._try_cache(job):
-            return True
-        pool = self.ex._decode_pool
-        if pool is not None:
-            pool.schedule(path)
-            for p in self.queue.peek_paths(max(pool.workers - 1, 0)):
-                pool.schedule(p)
         try:
-            self.session.ingest(path, retries=0)
+            # first traffic for a co-loaded model constructs its extractor
+            # here, on the daemon thread, sharing the primary's resources
+            ex = self.sessions.extractor(model)
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:  # noqa: BLE001 — fault-barrier: a model whose lazy construction fails (missing weights, invalid derived config) must fail ITS job cleanly, not kill the daemon serving the other models
+            if not self._video_failed(path, e):
+                # terminal: no session exists to run the shared accounting,
+                # so record + count here (the exit code must stay honest)
+                print(f"[serve] cannot construct model {model!r} for "
+                      f"{path}: {e}", file=sys.stderr)
+                self._service_failures += 1
+                try:
+                    record_failure(feature_output_dir(
+                        self.cfg.output_path, model), path, e)
+                except OSError as rec_err:
+                    print(f"warning: could not record failure for {path}: "
+                          f"{rec_err}", file=sys.stderr)
+            return True
+        if self._try_cache(job, ex):
+            return True
+        # decode hints route per model and must not gate on the CURRENT
+        # job's pool: a popped non-frame-stream job (vggish) still hints
+        # queued frame-stream jobs of co-resident models (schedule_decode
+        # no-ops for models without a frame stream)
+        self.sessions.schedule_decode(path, model)
+        pool = self.sessions.decode_pool
+        if pool is not None:
+            for j in self.queue.peek_jobs(max(pool.workers - 1, 0)):
+                self.sessions.schedule_decode(
+                    j.path, j.feature_type or self.cfg.feature_type)
+        try:
+            self.session.ingest(path, model, retries=0)
         except KeyboardInterrupt:
             raise
         except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point (serving loop)
@@ -219,10 +322,9 @@ class ExtractionService:
             # on_failed hook) owns the requeue-vs-terminal decision so this
             # path, failed writes, and co-packed batch victims all share one
             # retry budget
-            self.session.fail(path, e)
+            self.session.fail(path, model, e)
         finally:
-            if pool is not None:
-                pool.release(path)
+            self.sessions.release_decode(path)
         self.session.emit_completed(reap_limit=1)
         return True
 
@@ -246,7 +348,8 @@ class ExtractionService:
                     self._maybe_finish_request(request, force=True)
         finally:
             self.close()
-        return 0 if self.ex._failures == 0 else 1
+        return (0 if self.sessions.failures == 0
+                and self._service_failures == 0 else 1)
 
     def request_drain(self) -> None:
         if not self._draining.is_set():
@@ -266,27 +369,28 @@ class ExtractionService:
         if self._closed:
             return
         self._closed = True
-        self.ex._close_run_resources()
+        self.sessions.close()
         self.ex.clock = None
 
-    def _try_cache(self, job) -> bool:
+    def _try_cache(self, job, ex) -> bool:
         """Feature-cache consult + in-flight coalescing for one popped job.
 
-        True when no extraction should run this step: the job was served
-        from the cache (outputs + manifests written, zero device steps) or
-        parked behind an identical in-flight extraction. Fairness holds
-        either way — the pop that got us here already advanced the tenant's
-        virtual time, and a parked waiter's replay is another pop.
+        ``ex`` is the job's MODEL extractor (multi-model daemons route every
+        consult, publish, and key memo through the owning model — its config
+        fingerprint keys the entry, so models never collide in the shared
+        store). True when no extraction should run this step: the job was
+        served from the cache (outputs + manifests written, zero device
+        steps) or parked behind an identical in-flight extraction. Fairness
+        holds either way — the pop that got us here already advanced the
+        tenant's virtual time, and a parked waiter's replay is another pop.
         """
-        ex = self.ex
         if ex._cache is None:
             return False
         path = job.path
+        model = job.feature_type or self.cfg.feature_type
         feats = ex._cache_fetch(path)
-        pool = ex._decode_pool
         if feats is not None:
-            if pool is not None:
-                pool.release(path)  # may have been prefetch-hint scheduled
+            self.sessions.release_decode(path)  # may have been hint-scheduled
             job.from_cache = True
             try:
                 ex._publish_cache_hit(path, feats,
@@ -294,7 +398,7 @@ class ExtractionService:
             except KeyboardInterrupt:
                 raise
             except Exception as e:  # noqa: BLE001 — fault-barrier: a hit's write failure is this video's own failure, owned by the shared requeue-vs-terminal logic
-                self.session.fail(path, e)
+                self.session.fail(path, model, e)
                 return True
             self.session.emit_completed(reap_limit=1)
             return True
@@ -304,8 +408,7 @@ class ExtractionService:
         if self._coalescer.wait(key, job):
             # identical extraction already in flight: park this job — the
             # leader's completion (or failure) re-enqueues it
-            if pool is not None:
-                pool.release(path)
+            self.sessions.release_decode(path)
             return True
         self._coalescer.lead(key, path)
         return False
@@ -390,19 +493,26 @@ class ExtractionService:
         exc = TenantBreakerOpen(
             f"{job.path}: {why} (tenant {job.request.tenant!r}); not "
             "attempted")
+        # manifest the fast failure under the job's OWN model's output tree
+        # (derivable without constructing a never-used model's extractor)
+        model = job.feature_type or self.cfg.feature_type
+        ex = self.sessions.peek_extractor(model)
+        out_dir = (ex.output_dir if ex is not None
+                   else feature_output_dir(self.cfg.output_path, model))
         try:
-            record_failure(self.ex.output_dir, job.path, exc)
+            record_failure(out_dir, job.path, exc)
         except OSError as e:
             print(f"warning: could not record failure for {job.path}: {e}",
                   file=sys.stderr)
-        pool = self.ex._decode_pool
-        if pool is not None:
-            pool.release(job.path)  # may have been prefetch-scheduled
+        self.sessions.release_decode(job.path)  # may have been hint-scheduled
         # a fast-failed ex-waiter still holds its consult-time cache key
         # (abspath-keyed, matching the memo — job.path is absolute by
         # admission, the abspath here is belt-and-braces)
-        self.ex._cache_keys.pop(os.path.abspath(job.path), None)
+        if ex is not None:
+            ex._cache_keys.pop(os.path.abspath(job.path), None)
         with self._lock:
+            self._jobs.pop(job.path, None)  # registered at pop; breaker-
+            # drained queue jobs were never popped, so the default is taken
             job.request.failed.append({
                 "video": job.path, "error_class": "TenantBreakerOpen",
                 "transient": False, "message": str(exc)[:500],
@@ -429,7 +539,7 @@ class ExtractionService:
 
     def _autoscale_tick(self) -> None:
         """Between requests: act on the interval's decode-starvation signal."""
-        pool = self.ex._decode_pool
+        pool = self.sessions.decode_pool
         if self._autoscaler is None or pool is None:
             return
         now = time.perf_counter()
@@ -452,7 +562,7 @@ class ExtractionService:
         with self._lock:
             return (self.queue.pending() == 0 and not self._jobs
                     and not self.packer.has_pending()
-                    and not self.ex._pending_writes)
+                    and not self.sessions.pending_writes())
 
     def _load_tenants_config(self, initial: bool = False) -> None:
         path = os.path.join(self.cfg.spool_dir, SPOOL_TENANTS_FILE)
@@ -476,6 +586,7 @@ class ExtractionService:
             if request is not None:
                 return {"ok": True, "state": request.state,
                         "tenant": request.tenant,
+                        "feature_type": request.feature_type,
                         "videos": len(request.videos),
                         "done": len(request.done),
                         "failed": len(request.failed)}
@@ -507,18 +618,30 @@ class ExtractionService:
         }
 
     def stats(self) -> dict:
-        pool = self.ex._decode_pool
+        pool = self.sessions.decode_pool
+        # per-model rollup: packer occupancy by model × completion counters
+        # (only models that saw traffic appear — lazily-built extractors)
+        model_occ = self.packer.model_stats()
+        models = {}
+        for name, counts in self.sessions.model_counts().items():
+            models[name] = dict(counts)
+            models[name].update(model_occ.get(name, {}))
         with self._lock:
             return {
                 "ok": True,
                 "feature_type": self.cfg.feature_type,
+                "serving_models": list(self.models),
                 "draining": self._draining.is_set(),
                 "live_requests": len(self._requests),
                 "in_flight_videos": len(self._jobs),
                 "queued_videos": self.queue.pending(),
                 "completed_requests": self._completed_requests,
-                "videos_ok": self.ex._ok,
-                "videos_failed": self.ex._failures,
+                "videos_ok": self.sessions.ok,
+                "videos_failed": (self.sessions.failures
+                                  + self._service_failures),
+                # per-model occupancy/throughput (multi-model daemons: the
+                # one-line answer to "is model B starving the mesh?")
+                "models": models,
                 "packing": {
                     "real_slots": self.packer.real_slots,
                     "dispatched_slots": self.packer.dispatched_slots,

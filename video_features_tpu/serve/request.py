@@ -12,6 +12,10 @@ Schema (all extra keys ignored)::
     {
       "tenant": "alice",               # optional; "default" when omitted
       "videos": ["/abs/a.mp4", ...],   # required, non-empty list of paths
+      "feature_type": "i3d",           # optional; the daemon's --feature_type
+                                       # when omitted — admission validates it
+                                       # against the loaded model set
+                                       # (--serve_models, docs/serving.md)
       "deadline": 1767200000.0,        # optional absolute epoch seconds
       "deadline_sec": 30.0,            # optional relative; wins over nothing
       "request_id": "batch-7"          # optional (socket); spool uses the
@@ -58,6 +62,12 @@ class VideoJob:
     def deadline(self) -> Optional[float]:
         return self.request.deadline
 
+    @property
+    def feature_type(self) -> Optional[str]:
+        """The request's model (admission resolves None to the daemon's
+        default before the job is queued)."""
+        return self.request.feature_type
+
     def sort_key(self) -> Tuple[float, int]:
         """(deadline or +inf, admission order) — EDF within a tenant."""
         d = self.request.deadline
@@ -68,12 +78,16 @@ class ServiceRequest:
     """Parsed, admitted request plus its live completion state."""
 
     def __init__(self, request_id: str, tenant: str, videos: Tuple[str, ...],
-                 deadline: Optional[float] = None, source: str = "api"):
+                 deadline: Optional[float] = None, source: str = "api",
+                 feature_type: Optional[str] = None):
         self.request_id = request_id
         self.tenant = tenant
         self.videos = videos
         self.deadline = deadline
         self.source = source
+        # None until admission resolves it to the daemon's default model;
+        # a request naming an unloaded model is rejected at admission
+        self.feature_type = feature_type
         self.submitted_at = time.time()
         self.done: List[str] = []
         self.failed: List[Dict] = []  # {video, error_class, transient, message}
@@ -95,6 +109,7 @@ class ServiceRequest:
         return {
             "request_id": self.request_id,
             "tenant": self.tenant,
+            "feature_type": self.feature_type,
             "state": self.state,
             "videos": len(self.videos),
             "done": sorted(self.done),
@@ -136,9 +151,16 @@ def parse_request(payload, request_id: Optional[str] = None,
         deadline = time.time() + float(rel)
     elif deadline is not None and not isinstance(deadline, (int, float)):
         raise RequestRejected("'deadline' must be epoch seconds")
+    feature_type = payload.get("feature_type")
+    if feature_type is not None and (
+            not isinstance(feature_type, str) or not feature_type):
+        raise RequestRejected("'feature_type' must be a non-empty string "
+                              "naming a loaded model (omit for the daemon's "
+                              "default)")
     rid = request_id or payload.get("request_id") or uuid.uuid4().hex[:12]
     if not isinstance(rid, str) or not rid:
         raise RequestRejected("'request_id' must be a non-empty string")
     return ServiceRequest(rid, tenant, tuple(videos),
                           deadline=float(deadline) if deadline is not None
-                          else None, source=source)
+                          else None, source=source,
+                          feature_type=feature_type)

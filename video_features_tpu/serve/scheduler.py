@@ -202,13 +202,15 @@ class RequestQueue:
             t.vtime += 1.0 / t.weight
             return job
 
-    def peek_paths(self, n: int) -> List[str]:
-        """Up to ``n`` likely-next paths (decode-prefetch hints; approximate
-        order is fine — the pool buffers whatever is scheduled early)."""
+    def peek_jobs(self, n: int) -> List[VideoJob]:
+        """Up to ``n`` likely-next jobs (decode-prefetch hints; approximate
+        order is fine — the pool buffers whatever is scheduled early). Jobs,
+        not bare paths: the multi-model daemon routes each hint to its
+        model's decode transform."""
         with self._lock:
             entries = heapq.nsmallest(
                 n, (e for t in self._tenants.values() for e in t.heap))
-            return [e[2].path for e in entries]
+            return [e[2] for e in entries]
 
     def drain_tenant(self, tenant: str) -> List[VideoJob]:
         """Remove and return every queued job of ``tenant`` (breaker trip)."""
