@@ -73,6 +73,7 @@ _FAST_MODULES = {
     "test_ingest",
     "test_mirror_independence",
     "test_multimodel",
+    "test_obs",
     "test_packer",
     "test_packer_buckets",
     "test_parallel",

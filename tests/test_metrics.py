@@ -1,10 +1,20 @@
-"""Observability: stage clock semantics and the opt-in per-video report."""
+"""Observability: stage clock semantics (incl. thread safety and the
+telemetry-registry feed), the decode-starvation heuristic wired end-to-end
+from registry-fed values, histogram bucket/percentile math, and the opt-in
+per-video report."""
 # fast-registry: default tier — stage-clock tests with real sleeps
 
+import threading
 import time
 
 
-from video_features_tpu.utils.metrics import StageClock, maybe_profiler, metrics_enabled
+from video_features_tpu.obs import Histogram, MetricsRegistry
+from video_features_tpu.utils.metrics import (
+    StageClock,
+    decode_starvation_warning,
+    maybe_profiler,
+    metrics_enabled,
+)
 
 
 def test_stage_clock_accumulates():
@@ -36,6 +46,137 @@ def test_report_format():
         pass
     line = c.report("vid.mp4", wall=1.0)
     assert "vid.mp4" in line and "decode" in line and "overlapped/other" in line
+
+
+def test_stage_clock_increments_are_thread_safe():
+    """add_seconds/add_bytes/add_units arrive from staging-ring commit hooks
+    and the writer thread while timed_iter runs on the daemon thread — a
+    torn += would silently skew the report, so every mutation locks."""
+    c = StageClock()
+    n, per = 4, 5000
+
+    def work():
+        for _ in range(per):
+            c.add_seconds("decode", 1.0)
+            c.add_bytes("decode", 3)
+            c.add_units("clips", 2)
+
+    threads = [threading.Thread(target=work) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.seconds["decode"] == float(n * per)
+    assert c.bytes["decode"] == 3 * n * per
+    assert c.units["clips"] == 2 * n * per
+
+
+def test_stage_clock_feeds_the_registry():
+    reg = MetricsRegistry()
+    c = StageClock(registry=reg, labels={"model": "resnet50"})
+    c.add_seconds("decode", 1.5)
+    with c.stage("device_wait"):
+        pass
+    c.add_bytes("transfer", 1024)
+    c.add_units("packed_slots", 8)
+    assert reg.counter_value("stage_seconds_total", stage="decode",
+                             model="resnet50") == 1.5
+    # the stage() context-manager arm must CREATE the labeled series (a
+    # bare >= 0.0 check would pass on the missing-series default of 0.0)
+    fed_stages = {tuple(sorted(c["labels"].items()))
+                  for c in reg.snapshot()["counters"]
+                  if c["name"] == "stage_seconds_total"}
+    assert (("model", "resnet50"), ("stage", "device_wait")) in fed_stages
+    assert reg.counter_value("stage_bytes_total", stage="transfer",
+                             model="resnet50") == 1024
+    assert reg.counter_value("stage_units_total", stage="packed_slots",
+                             model="resnet50") == 8
+
+
+def test_timed_iter_feeds_registry_bytes():
+    reg = MetricsRegistry()
+    c = StageClock(registry=reg)
+    items = [b"abcd", b"xy"]
+    assert list(c.timed_iter(iter(items), "decode", bytes_of=len)) == items
+    assert reg.counter_value("stage_bytes_total", stage="decode") == 6
+    assert c.bytes["decode"] == 6
+
+
+def test_starvation_warning_wired_from_registry_fed_values():
+    """The decode-starvation heuristic driven end-to-end from values READ
+    BACK out of the registry the stage clock fed — not hand-passed floats:
+    the same path the serving daemon's autoscaler/stats consumers take."""
+    reg = MetricsRegistry()
+    clock = StageClock(registry=reg, labels={"model": "resnet50"})
+    clock.add_seconds("decode", 4.5)  # decode-bound interval
+    clock.add_units("packed_slots", 100)
+    clock.add_units("packed_clips", 60)  # occupancy 0.6 < 0.8
+
+    def counter(metric, stage):
+        return reg.counter_value(metric, stage=stage, model="resnet50")
+
+    occupancy = (counter("stage_units_total", "packed_clips")
+                 / counter("stage_units_total", "packed_slots"))
+    msg = decode_starvation_warning(
+        occupancy=occupancy,
+        decode_seconds=counter("stage_seconds_total", "decode"),
+        wall=10.0,
+        transfer_seconds=counter("stage_seconds_total", "transfer"))
+    assert msg is not None and "--decode_workers" in msg
+
+    # transfer-bound interval: same registry path, other branch
+    reg2 = MetricsRegistry()
+    clock2 = StageClock(registry=reg2, labels={"model": "raft"})
+    clock2.add_seconds("decode", 0.2)
+    clock2.add_seconds("transfer", 4.5)
+    clock2.add_units("packed_slots", 100)
+    clock2.add_units("packed_clips", 60)
+    msg2 = decode_starvation_warning(
+        occupancy=0.6,
+        decode_seconds=reg2.counter_value("stage_seconds_total",
+                                          stage="decode", model="raft"),
+        wall=10.0,
+        transfer_seconds=reg2.counter_value("stage_seconds_total",
+                                            stage="transfer", model="raft"))
+    assert msg2 is not None and "float32_wire" in msg2
+
+    # healthy occupancy read back from the registry: no warning
+    reg3 = MetricsRegistry()
+    clock3 = StageClock(registry=reg3)
+    clock3.add_units("packed_slots", 100)
+    clock3.add_units("packed_clips", 95)
+    assert decode_starvation_warning(
+        occupancy=reg3.counter_value("stage_units_total",
+                                     stage="packed_clips")
+        / reg3.counter_value("stage_units_total", stage="packed_slots"),
+        decode_seconds=9.0, wall=10.0) is None
+
+
+def test_histogram_bucket_boundaries_are_le_inclusive():
+    h = Histogram(bounds=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 9.0):
+        h.observe(v)
+    # Prometheus le semantics: a value ON a bound lands in that bucket
+    assert h.counts == [2, 2, 2, 1]
+    assert h.bucket_index(1.0) == 0 and h.bucket_index(1.0000001) == 1
+    assert h.bucket_index(100.0) == 3  # overflow bucket
+
+
+def test_histogram_percentiles_interpolate_within_buckets():
+    h = Histogram(bounds=(1.0, 2.0))
+    for k in range(1, 101):
+        h.observe(k / 100)  # uniform over (0, 1]
+    assert abs(h.quantile(0.5) - 0.5) < 1e-9
+    assert abs(h.quantile(0.99) - 0.99) < 1e-9
+    # overflow values clamp to the last finite bound
+    h_over = Histogram(bounds=(1.0, 2.0))
+    for _ in range(10):
+        h_over.observe(50.0)
+    assert h_over.quantile(0.5) == 2.0
+    # empty histogram quantiles are 0 (nothing observed, nothing claimed)
+    assert Histogram().quantile(0.99) == 0.0
+    # sum/count bookkeeping
+    assert h.count == 100 and abs(h.sum - 50.5) < 1e-9
 
 
 def test_metrics_enabled_gates():

@@ -11,6 +11,13 @@ driving the REAL CLI surface as an operator would — no test harness imports:
 3. a RESUBMIT of alice's videos must be served entirely from the feature
    cache (``cache_hits`` in its result record, hits in the socket ``stats``
    op — docs/caching.md);
+3b. telemetry (docs/observability.md): the daemon runs with
+   ``--telemetry_dir``; the script asserts the versioned ``stats`` payload
+   (``"schema": 1`` + per-tenant latency summaries), hits ``healthz`` and
+   ``metrics`` (Prometheus text), runs one ``profile start/stop`` cycle
+   against the live daemon, and — after the drain — exports the journal
+   with ``python -m video_features_tpu.obs.export`` and validates the
+   Chrome trace parses as JSON with a complete span chain per request;
 4. the daemon co-loads a second model (``--serve_models r21d_rgb``,
    docs/serving.md): a mixed-traffic step submits carol's request with
    ``"feature_type": "r21d_rgb"`` to the SAME daemon and asserts
@@ -128,11 +135,13 @@ def main() -> int:
     serve_out = os.path.join(root, "serve")
     print("[smoke] starting the daemon (co-resident models: resnet50 + "
           "r21d_rgb)")
+    telemetry_dir = os.path.join(root, "telemetry")
     daemon = subprocess.Popen(
         cli(serve_out, "--serve", "--spool_dir", spool,
             "--idle_flush_sec", "0.05", "--spool_poll_sec", "0.05",
             "--serve_models", "r21d_rgb",
-            "--cache_dir", os.path.join(root, "cache")),
+            "--cache_dir", os.path.join(root, "cache"),
+            "--telemetry_dir", telemetry_dir),
         env=env)
     try:
         for tenant, vids in videos.items():
@@ -164,11 +173,37 @@ def main() -> int:
         assert record["state"] == "done", record
         assert record["cache_hits"] == len(videos["alice"]), record
         stats = sock_op(os.path.join(spool, "control.sock"), {"op": "stats"})
+        # versioned payload: external scrapers pin the schema key and treat
+        # a bump as a breaking change (docs/serving.md documents the tree)
+        assert stats["schema"] == 1, stats.get("schema")
         assert stats["cache"]["hits"] >= len(videos["alice"]), stats["cache"]
         assert stats["cache"]["hit_rate"] > 0, stats["cache"]
         print(f"[smoke] resubmit served from cache "
               f"({record['cache_hits']} hits; cumulative hit rate "
               f"{stats['cache']['hit_rate']:.0%})")
+
+        # telemetry ops: healthz liveness, Prometheus metrics, and one
+        # profile start/stop cycle against the LIVE daemon
+        sock = os.path.join(spool, "control.sock")
+        health = sock_op(sock, {"op": "healthz"})
+        assert health["ok"] and health["stale"] is False, health
+        assert health["uptime_sec"] > 0, health
+        metrics = sock_op(sock, {"op": "metrics"})
+        assert metrics["ok"] and metrics["schema"] == 1, metrics.get("ok")
+        assert "vft_e2e_latency_seconds_bucket" in metrics["prometheus"], \
+            metrics["prometheus"][:400]
+        latency = {s["labels"]["tenant"]: s
+                   for s in stats["latency"]["e2e"]}
+        assert {"alice", "bob"} <= set(latency), stats["latency"]
+        assert all(s["p50"] <= s["p99"] for s in latency.values()), latency
+        print(f"[smoke] healthz ok (last step {health['last_step_age_sec']}s"
+              f" ago); e2e p99: "
+              + ", ".join(f"{t}={s['p99']}s" for t, s in latency.items()))
+        prof = sock_op(sock, {"op": "profile", "action": "start"})
+        assert prof["ok"], prof
+        prof2 = sock_op(sock, {"op": "profile", "action": "stop"})
+        assert prof2["ok"], prof2
+        print(f"[smoke] profile cycle ok → {prof2['trace_dir']}")
 
         # two-model mixed traffic: carol's r21d_rgb request rides the SAME
         # daemon/mesh as the resnet50 tenants; byte parity vs the
@@ -246,8 +281,36 @@ def main() -> int:
                            ".done_manifest.jsonl")) as f:
         done_r = {json.loads(line)["video"] for line in f}
     assert len(done_r) == len(r21d_videos), sorted(done_r)
+
+    # telemetry journal → Chrome trace: the exported file must parse as
+    # JSON and hold a COMPLETE request span (admitted→done, ph "X") for
+    # every accepted request, plus ≥1 per-video span each
+    print("[smoke] exporting the telemetry journal to a Chrome trace")
+    journal = os.path.join(telemetry_dir, "events.jsonl")
+    trace_path = os.path.join(root, "trace.json")
+    subprocess.run([sys.executable, "-m", "video_features_tpu.obs.export",
+                    journal, "-o", trace_path],
+                   env=env, check=True, timeout=60, cwd=REPO)
+    with open(trace_path) as f:
+        trace = json.load(f)
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    req_spans = {e["args"].get("request") for e in xs
+                 if e["name"] == "request"}
+    accepted = {"req_alice", "req_bob", "req_alice2", "req_carol"}
+    assert accepted <= req_spans, (sorted(req_spans), sorted(accepted))
+    per_video = [e for e in xs if e["name"] in ("queue_wait", "process")]
+    assert len(per_video) >= len(videos["alice"]) + len(videos["bob"]), \
+        len(per_video)
+    # the rejected request journaled its rejection, not a span
+    instants = {(e.get("name"), e["args"].get("request"))
+                for e in trace["traceEvents"] if e.get("ph") == "i"}
+    assert ("request_rejected", "req_unknown") in instants
+    print(f"[smoke] trace ok: {len(req_spans)} request spans, "
+          f"{len(per_video)} per-video spans")
+
     print(f"[smoke] PASS: {len(want)} + {len(want_r)} outputs "
-          "byte-identical across two co-resident models, manifests intact")
+          "byte-identical across two co-resident models, manifests intact, "
+          "telemetry trace complete")
     return 0
 
 

@@ -26,6 +26,10 @@ The declared sites and their disciplines:
 - ``parallel/pipeline.py`` ``slot['bytes']``: the byte-cap accounting for the
   decode buffer — incremented by the worker after each enqueue, decremented
   by the consumer's drain after each dequeue, both under ``slot['lock']``.
+- ``obs/journal.py`` ``self._written`` / ``self._write_errors``: advanced
+  only by the single telemetry-writer thread; ``stats()`` readers take a
+  GIL-atomic load of a monotone int (an off-by-one-moment read is fine for
+  a counter that only reports).
 
 ``reliability/watchdog.py`` and ``extractors/flow.py`` spawn threads whose
 targets publish through list-append / Event-set / queue operations only —
@@ -62,6 +66,10 @@ THREAD_MODULES: Dict[str, str] = {
     # through ExtractionService's RLock-guarded methods and the RequestQueue
     # lock — the thread entries themselves store nothing shared
     "video_features_tpu/serve/ingest.py": "spool watcher + socket API ingest",
+    # telemetry journal writer: one bounded single-writer thread appending
+    # JSONL (the AsyncOutputWriter discipline applied to telemetry);
+    # producers only queue-put, the writer only advances its own counters
+    "video_features_tpu/obs/journal.py": "telemetry journal writer",
 }
 
 # declared cross-thread stores: module -> {canonical site: discipline}
@@ -75,6 +83,13 @@ SHARED_WRITES: Dict[str, Dict[str, str]] = {
         "slot['bytes']": "guarded by slot['lock'] (worker increments after "
                          "enqueue; the consumer drain decrements after "
                          "dequeue under the same lock)",
+    },
+    "video_features_tpu/obs/journal.py": {
+        "self._written": "written only by the single writer thread; stats "
+                         "readers take a GIL-atomic monotone int load",
+        "self._write_errors": "written only by the single writer thread; "
+                              "stats readers take a GIL-atomic monotone "
+                              "int load",
     },
 }
 

@@ -82,6 +82,8 @@ EXECUTION_FIELDS = (
     "precompile",              # compile scheduling
     "async_writer",            # write scheduling, same bytes
     "profile_dir",             # observability
+    "telemetry_dir",           # observability: the span journal records the
+                               # run, it never touches feature bytes
     "retries",                 # reliability policy
     "retry_backoff",           # reliability policy
     "video_timeout",           # reliability policy

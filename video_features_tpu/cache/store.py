@@ -68,6 +68,12 @@ class FeatureCache:
         self.evictions = 0
         self.evicted_bytes = 0
         self.quarantined = 0
+        # optional ..obs.SpanJournal (set by the owning extractor once
+        # telemetry opens): quarantines and evictions are rare, operator-
+        # relevant events — they land in the journal alongside the request
+        # lifecycle so "why did the hit rate dip?" is answerable after the
+        # fact. Emit-only; a missing journal costs one None check.
+        self.journal = None
 
     # --- read ----------------------------------------------------------------
 
@@ -160,6 +166,9 @@ class FeatureCache:
             moved = f"could not quarantine ({move_err})"
         self.quarantined += 1
         self._drop_accounting(path)
+        if self.journal is not None:
+            self.journal.emit("cache_quarantine",
+                              entry=os.path.basename(path))
         print(f"warning: [{err_class}] corrupt cache entry "
               f"{os.path.basename(path)}: {exc}; {moved}; treating as a miss",
               file=sys.stderr)
@@ -195,6 +204,9 @@ class FeatureCache:
             self._drop_accounting(path)
             self.evictions += 1
             self.evicted_bytes += size
+            if self.journal is not None:
+                self.journal.emit("cache_evict",
+                                  entry=os.path.basename(path), bytes=size)
 
     def _scan(self) -> None:
         for dirpath, dirnames, filenames in os.walk(self.cache_dir):

@@ -276,6 +276,15 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", default=None,
                         help="write a jax.profiler trace here and print per-video "
                              "stage timing (decode vs device wait)")
+    parser.add_argument("--telemetry_dir", default=None,
+                        help="write a structured span/event journal "
+                             "(<dir>/events.jsonl) of every request/video "
+                             "lifecycle — queued, popped, decode, device, "
+                             "done/failed, cache hits, breaker trips — via a "
+                             "bounded writer thread that never blocks the "
+                             "hot path; export a Chrome/Perfetto trace with "
+                             "`python -m video_features_tpu.obs.export "
+                             "<dir>/events.jsonl` (docs/observability.md)")
     parser.add_argument("--matmul_precision", default=None,
                         choices=["default", "high", "highest"],
                         help="TPU fp32 matmul/conv precision; 'highest' for "

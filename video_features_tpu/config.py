@@ -163,6 +163,18 @@ class ExtractionConfig:
     # (decode vs device_wait vs overlapped time). VFT_METRICS=1 enables the
     # report without tracing.
     profile_dir: Optional[str] = None
+    # Telemetry directory (docs/observability.md): a structured span/event
+    # journal (<dir>/events.jsonl) records every request and video lifecycle
+    # (queued → popped → decode → dispatched → device → done/failed, plus
+    # cache hits, stale flushes, autoscale resizes, breaker trips) with
+    # monotonic timestamps, appended by a bounded single-writer thread that
+    # NEVER blocks the hot path (a full queue drops the event and counts the
+    # drop). Export to a Chrome/Perfetto trace with
+    # `python -m video_features_tpu.obs.export <dir>/events.jsonl`. Works in
+    # batch runs and the --serve daemon (which also serves healthz/metrics/
+    # profile socket ops from the same subsystem). None = off (no journal;
+    # the daemon's in-memory metrics registry stays on regardless).
+    telemetry_dir: Optional[str] = None
     # TPU fp32 convs default to bf16 MXU passes; "highest" gives true-fp32
     # accumulation for the bit-parity path (None = XLA default).
     matmul_precision: Optional[str] = None

@@ -49,6 +49,8 @@ from ..reliability import (
     retry_call,
     run_with_timeout,
 )
+from ..obs import MetricsRegistry, SpanJournal
+from ..obs.journal import JOURNAL_NAME
 from ..utils.metrics import (
     StageClock,
     decode_starvation_warning,
@@ -110,6 +112,16 @@ class Extractor(abc.ABC):
                        or MeshRunner(cfg.num_devices, cfg.matmul_precision))
         # per-video stage clock; active only when metrics are enabled (run())
         self.clock: Optional[StageClock] = None
+        # telemetry (docs/observability.md): the span/event journal
+        # (--telemetry_dir) and the metrics registry. Opened by
+        # _open_telemetry (run resources); a co-loaded serving model shares
+        # the primary's instances — one journal file, one registry, one
+        # writer thread across every co-resident model
+        self._journal: Optional[SpanJournal] = \
+            _CONSTRUCTION_SHARING.get("journal")
+        self._metrics: Optional[MetricsRegistry] = \
+            _CONSTRUCTION_SHARING.get("metrics")
+        self._owns_journal = False
         # cross-video decode pool; created by run() when --decode_workers > 1
         # (0 = auto: _resolve_decode_workers picks the start size and the
         # serving daemon resizes it live); _decode_workers is the resolved
@@ -224,6 +236,47 @@ class Extractor(abc.ABC):
         return self._open_inline(video_path)
 
     # --- observability hooks (no-ops unless metrics are enabled) ---
+
+    def _open_telemetry(self) -> None:
+        """Open the span journal (``--telemetry_dir``) and the metrics
+        registry. Part of the run resources — the batch loops get it per
+        ``run()``, the serving daemon for its lifetime. Idempotent; a
+        registry set externally (the daemon's) or a journal inherited from
+        the construction-sharing seam (a co-loaded model) is kept."""
+        if self._metrics is None and (self.cfg.telemetry_dir or self.cfg.serve):
+            self._metrics = MetricsRegistry()
+        if self.cfg.telemetry_dir and (
+                self._journal is None or self._journal.closed):
+            self._journal = SpanJournal(
+                os.path.join(self.cfg.telemetry_dir, JOURNAL_NAME))
+            self._owns_journal = True
+        if self._cache is not None:
+            # the store reports quarantines/evictions into the same journal
+            self._cache.journal = self._journal
+
+    def _emit(self, event: str, **fields) -> None:
+        """Append one journal event (no-op without --telemetry_dir); the
+        emit is a non-blocking queue put — never the hot path's problem."""
+        if self._journal is not None:
+            self._journal.emit(event, model=self.feature_type, **fields)
+
+    def _span(self, name: str, **fields):
+        """Journal span context (``<name>_start``/``<name>_end`` pair)."""
+        if self._journal is None:
+            return contextlib.nullcontext()
+        return self._journal.span(name, model=self.feature_type, **fields)
+
+    def _mark_succeeded(self, path: str) -> None:
+        """Shared per-video success accounting: the run counter, the
+        failure-manifest prune list, and telemetry — every success arm
+        (inline write, async-write reap, packed finalize, cache-hit replay)
+        lands here so the journal's ``video_done`` stream and the
+        ``videos_ok_total`` counter agree with the manifests exactly."""
+        self._ok += 1
+        self._succeeded.append(path)
+        self._emit("video_done", video=path)
+        if self._metrics is not None:
+            self._metrics.inc("videos_ok_total", model=self.feature_type)
 
     def _timed_frames(self, frames_iter):
         """Attribute host time blocked on decode/transform to the 'decode'
@@ -341,12 +394,15 @@ class Extractor(abc.ABC):
         return workers
 
     def _open_run_resources(self) -> None:
-        """Decode pool + async writer + per-run accounting, shared by
-        :meth:`run` and the serving daemon's caller-managed session."""
+        """Decode pool + async writer + telemetry + per-run accounting,
+        shared by :meth:`run` and the serving daemon's caller-managed
+        session."""
+        self._open_telemetry()
         workers = self._resolve_decode_workers()
         self._decode_workers = workers
         if workers > 1 and self.uses_frame_stream:
-            self._decode_pool = DecodePrefetcher(self._open_inline, workers)
+            self._decode_pool = DecodePrefetcher(self._open_inline, workers,
+                                                 journal=self._journal)
         elif workers > 1:
             print(f"--decode_workers ignored: {self.feature_type} does not "
                   "consume the frame stream (whole-video / audio decode)")
@@ -386,6 +442,12 @@ class Extractor(abc.ABC):
         # even on KeyboardInterrupt / circuit breaker: converge the failure
         # manifest for everything that DID succeed this run
         self._prune_succeeded(self._succeeded)
+        # the journal closes LAST so every unwind arm above could still emit;
+        # the closed object is kept for the run report's counters (a second
+        # run() reopens in append mode). Shared journals (a co-loaded serving
+        # model) are closed by their owning primary only.
+        if self._owns_journal and self._journal is not None:
+            self._journal.close()
 
     def _process_one(self, path: str,
                      cancelled: Optional[threading.Event] = None,
@@ -488,6 +550,7 @@ class Extractor(abc.ABC):
         if feats is not None:
             # the key's job is done; a hit republishes nothing
             self._cache_keys.pop(os.path.abspath(path), None)
+            self._emit("cache_hit", video=path)
         return feats
 
     def _publish_cache_hit(self, path: str, feats: Dict[str, np.ndarray],
@@ -501,8 +564,7 @@ class Extractor(abc.ABC):
         if handle is not None:
             self._pending_writes.append((path, handle))
         else:
-            self._ok += 1
-            self._succeeded.append(path)
+            self._mark_succeeded(path)
             if on_done is not None:
                 on_done(path)
 
@@ -596,6 +658,11 @@ class Extractor(abc.ABC):
         self._cache_keys.pop(os.path.abspath(path), None)
         err_class, transient = classify(e)
         attempts = getattr(e, "attempts", 1)
+        self._emit("video_failed", video=path, error_class=err_class,
+                   transient=transient, attempts=attempts)
+        if self._metrics is not None:
+            self._metrics.inc("videos_failed_total", model=self.feature_type,
+                              error_class=err_class)
         # best-effort: the manifest write hitting the same dying
         # disk as the failure itself must not escape the barrier
         try:
@@ -649,8 +716,7 @@ class Extractor(abc.ABC):
                 self._fail(wpath, e)
                 continue
             pending_writes.popleft()
-            self._ok += 1
-            self._succeeded.append(wpath)
+            self._mark_succeeded(wpath)
             if on_done is not None:
                 on_done(wpath)
 
@@ -678,7 +744,9 @@ class Extractor(abc.ABC):
                     if progress:
                         progress(n, len(paths))
                     continue
-                self.clock = StageClock() if with_metrics else None
+                self.clock = (StageClock(registry=self._metrics,
+                                         labels={"model": self.feature_type})
+                              if with_metrics else None)
                 t0 = time.perf_counter()
                 # consult the cache BEFORE decode: a hit dispatches nothing —
                 # no decode stream, no device step (_cache_fetch never raises;
@@ -699,15 +767,15 @@ class Extractor(abc.ABC):
                         self._publish_cache_hit(path, feats)
                         handle = None  # accounted inside the helper
                     else:
-                        handle = self._attempt_with_retries(path)
+                        with self._span("extract", video=path):
+                            handle = self._attempt_with_retries(path)
                         extracted += 1
                     if self.clock is not None:
                         print(self.clock.report(path, time.perf_counter() - t0))
                     if handle is not None:
                         pending_writes.append((path, handle))
                     elif feats is None:
-                        self._ok += 1
-                        self._succeeded.append(path)
+                        self._mark_succeeded(path)
                 except KeyboardInterrupt:
                     raise
                 except Exception as e:  # noqa: BLE001 — fault-barrier: the per-video isolation point
@@ -766,7 +834,9 @@ class Extractor(abc.ABC):
             # corpus-level planning (e.g. the flow extractors' shape-bucket
             # clustering over container probes) before any decode starts
             spec.prepare(todo)
-        self.clock = StageClock() if with_metrics else None  # corpus-level
+        self.clock = (StageClock(registry=self._metrics,
+                                 labels={"model": self.feature_type})
+                      if with_metrics else None)  # corpus-level
         session = PackedSession(self, spec)
         packer = session.packer
         self._pending_writes.clear()
@@ -880,7 +950,8 @@ class PackedSession:
         if packer is None:
             packer = CorpusPacker(spec, wait=ex._wait, clock=ex.clock,
                                   flush_age=ex.cfg.pack_flush_age,
-                                  staging=ex._staging)
+                                  staging=ex._staging, journal=ex._journal,
+                                  metrics=ex._metrics)
             if model is not None:
                 packer.register_model(model, spec)
         else:
@@ -915,12 +986,13 @@ class PackedSession:
             if ex._decode_pool is not None:
                 ex._decode_pool.release(path)
 
-        retry_call(
-            lambda: self._drain_stream(path),
-            RetryPolicy(attempts=retries + 1,
-                        base_delay=ex.cfg.retry_backoff),
-            on_retry=on_retry,
-        )
+        with ex._span("extract", video=path):
+            retry_call(
+                lambda: self._drain_stream(path),
+                RetryPolicy(attempts=retries + 1,
+                            base_delay=ex.cfg.retry_backoff),
+                on_retry=on_retry,
+            )
 
     def _drain_stream(self, path: str) -> None:
         """One attempt at one video: pack every clip of its stream."""
@@ -976,8 +1048,7 @@ class PackedSession:
             if handle is not None:
                 ex._pending_writes.append((asm.video, handle))
             else:
-                ex._ok += 1
-                ex._succeeded.append(asm.video)
+                ex._mark_succeeded(asm.video)
                 if self._on_done is not None:
                     self._on_done(asm.video)
             self._forget_video(asm.video)
@@ -1125,7 +1196,8 @@ class MultiModelSessions:
                                 * len(self.models)))
         self.packer = CorpusPacker(
             wait=primary._wait, clock=primary.clock,
-            flush_age=primary.cfg.pack_flush_age, staging=primary._staging)
+            flush_age=primary.cfg.pack_flush_age, staging=primary._staging,
+            journal=primary._journal, metrics=primary._metrics)
         self._extractors: Dict[str, Extractor] = {
             primary.feature_type: primary}
         # path → extractor, for the shared decode pool's router; written on
@@ -1173,7 +1245,9 @@ class MultiModelSessions:
         primary = self.primary
         with _shared_construction(runner=primary.runner,
                                   staging=primary._staging,
-                                  cache=primary._cache):
+                                  cache=primary._cache,
+                                  journal=primary._journal,
+                                  metrics=primary._metrics):
             ex = self._factory(model)
         ex.clock = primary.clock
         ex._writer = primary._writer
@@ -1213,7 +1287,8 @@ class MultiModelSessions:
             return self.primary._decode_pool
         if self._pool is None and self.primary._decode_workers > 1:
             self._pool = DecodePrefetcher(self._open_routed,
-                                          self.primary._decode_workers)
+                                          self.primary._decode_workers,
+                                          journal=self.primary._journal)
         return self._pool
 
     def _open_routed(self, path: str):
@@ -1299,6 +1374,9 @@ class MultiModelSessions:
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
+        for ex in secondaries:
+            ex._journal = None  # shared: the primary closes it (after its
+            # own unwind arms have emitted their last events)
         primary._close_run_resources()
         for ex in secondaries:
             ex._writer = None  # the shared writer is closed and drained

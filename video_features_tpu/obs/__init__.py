@@ -1,0 +1,35 @@
+"""Observability subsystem: span journal, metrics registry, trace export.
+
+The serving daemon (PRs 6-9) turned the batch pipeline into an always-on,
+multi-tenant, multi-model system — but its observability stayed batch-shaped:
+a per-run stage-clock line, an opt-in ``jax.profiler`` wrapper, and a
+point-in-time ``stats`` snapshot. This package adds the durable record of
+*what happened when* (docs/observability.md):
+
+- :class:`SpanJournal` — structured lifecycle events (admitted → queued →
+  popped → decode → dispatched → device → write → done/failed, plus cache
+  hits, coalesces, stale flushes, autoscale resizes, breaker trips) appended
+  as JSONL by a bounded single-writer thread. Drops are counted, the hot
+  path never blocks — the ``AsyncOutputWriter`` discipline applied to
+  telemetry.
+- :class:`MetricsRegistry` — named counters/gauges and fixed-bucket
+  :class:`Histogram`\\ s (queue-wait, end-to-end latency, decode/device/
+  transfer seconds) labeled by tenant and model, with p50/p95/p99 summaries
+  and a Prometheus text exposition.
+- :mod:`.export` — a Chrome-trace/Perfetto converter for the journal
+  (``python -m video_features_tpu.obs.export <events.jsonl>``).
+
+Enable with ``--telemetry_dir DIR`` (batch runs and the ``--serve`` daemon;
+the daemon additionally serves ``healthz``/``metrics``/``profile`` socket
+ops and keeps the registry on regardless).
+"""
+
+from .journal import SpanJournal
+from .metrics import DEFAULT_BUCKETS, Histogram, MetricsRegistry
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "Histogram",
+    "MetricsRegistry",
+    "SpanJournal",
+]

@@ -1,0 +1,225 @@
+"""Journal → Chrome-trace (Perfetto / ``chrome://tracing``) converter.
+
+The span journal is an append-only event log; this module folds it into the
+Chrome trace-event JSON that Perfetto (https://ui.perfetto.dev) and
+``chrome://tracing`` load directly — the "per-op timeline an operator can
+actually look at" layer (docs/observability.md has the walkthrough)::
+
+    python -m video_features_tpu.obs.export <telemetry_dir>/events.jsonl \
+        -o trace.json
+
+Three kinds of trace slices come out:
+
+- **explicit spans** — ``<name>_start`` / ``<name>_end`` pairs sharing a
+  ``span`` id (``decode``, ``extract``, ``device``) become complete ``"X"``
+  events with real durations;
+- **derived lifecycle spans** — per video, ``video_queued``/``video_requeued``
+  → ``video_popped`` becomes a ``queue_wait`` slice and ``video_popped`` →
+  ``video_done``/``video_failed`` a ``process`` slice; per request,
+  ``request_admitted`` → ``request_done`` becomes a ``request`` slice. These
+  are exactly the latency histograms' definitions, so trace and histograms
+  cross-check;
+- **instants** — everything else (cache hits, stale flushes, autoscale
+  resizes, breaker trips) becomes a thread-scoped instant marker.
+
+Tracks (``tid``): one per video, one per request, one catch-all ``daemon``
+track; ``thread_name`` metadata labels them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_PID = 1
+# journal records that are bookkeeping, not timeline content
+_META_EVENTS = {"journal_open", "journal_close"}
+
+
+def load_journal(path: str) -> Tuple[List[dict], int]:
+    """(events sorted by ts, corrupt-line count). Corrupt lines — a torn
+    tail from a kill mid-append — are counted and skipped, never fatal."""
+    events: List[dict] = []
+    corrupt = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                if (not isinstance(rec, dict)
+                        or not isinstance(rec.get("ts"), (int, float))
+                        or isinstance(rec.get("ts"), bool)
+                        or "event" not in rec):
+                    # a non-numeric ts would crash the sort below — that is
+                    # a corrupt line too, counted not fatal
+                    raise ValueError("not an event record")
+            except ValueError:
+                corrupt += 1
+                continue
+            events.append(rec)
+    events.sort(key=lambda e: e["ts"])
+    return events, corrupt
+
+
+def _track_of(ev: dict) -> str:
+    video = ev.get("video")
+    if video is not None:
+        return str(video)
+    request = ev.get("request")
+    if request is not None:
+        return f"request {request}"
+    return "daemon"
+
+
+class _Tracks:
+    """Stable small-int tid per track name, first-seen order."""
+
+    def __init__(self):
+        self._tids: Dict[str, int] = {}
+
+    def tid(self, name: str) -> int:
+        tid = self._tids.get(name)
+        if tid is None:
+            tid = self._tids[name] = len(self._tids) + 1
+        return tid
+
+    def metadata(self) -> List[dict]:
+        return [{"ph": "M", "pid": _PID, "tid": tid, "name": "thread_name",
+                 "args": {"name": name}}
+                for name, tid in self._tids.items()]
+
+
+def _args_of(ev: dict) -> dict:
+    return {k: v for k, v in ev.items()
+            if k not in ("ts", "event", "span")}
+
+
+def to_chrome_trace(events: Sequence[dict]) -> dict:
+    """Fold journal events into a Chrome trace-event document."""
+    timeline = [e for e in events if e["event"] not in _META_EVENTS]
+    t0 = min((e["ts"] for e in timeline), default=0.0)
+
+    def us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 1)
+
+    tracks = _Tracks()
+    out: List[dict] = []
+
+    def slice_event(name: str, begin: dict, end: dict,
+                    track: Optional[str] = None) -> None:
+        out.append({
+            "ph": "X", "pid": _PID,
+            "tid": tracks.tid(track or _track_of(begin)),
+            "name": name, "cat": "vft",
+            "ts": us(begin["ts"]),
+            "dur": max(us(end["ts"]) - us(begin["ts"]), 0.1),
+            "args": {**_args_of(begin),
+                     **({"state": end["event"]}
+                        if end["event"] != begin["event"] else {})},
+        })
+
+    # explicit spans: pair *_start / *_end on (span NAME, span id) — ids
+    # restart at 1 per journal session, so the id alone is not unique
+    open_spans: Dict[object, dict] = {}
+    # lifecycle milestones per video / request
+    queued_at: Dict[str, dict] = {}
+    popped_at: Dict[str, dict] = {}
+    admitted_at: Dict[str, dict] = {}
+    paired = 0
+
+    for ev in events:
+        name = ev["event"]
+        if name in _META_EVENTS:
+            if name == "journal_open":
+                # a new journal session (the file accumulates across runs in
+                # append mode, and span ids restart with it): a run killed
+                # mid-span must leave its start UNPAIRED, not pair it with
+                # an unrelated later session's end
+                open_spans.clear()
+            continue
+        sid = ev.get("span")
+        if sid is not None and name.endswith("_start"):
+            open_spans[(name[: -len("_start")], sid)] = ev
+            continue
+        if sid is not None and name.endswith("_end"):
+            begin = open_spans.pop((name[: -len("_end")], sid), None)
+            if begin is not None:
+                slice_event(name[: -len("_end")], begin, ev)
+                paired += 1
+            continue
+        video = ev.get("video")
+        if name in ("video_queued", "video_requeued") and video is not None:
+            queued_at[video] = ev
+        elif name == "video_popped" and video is not None:
+            begin = queued_at.pop(video, None)
+            if begin is not None:
+                slice_event("queue_wait", begin, ev)
+            popped_at[video] = ev
+        elif name in ("video_done", "video_failed") and video is not None:
+            begin = popped_at.pop(video, None)
+            if begin is not None:
+                slice_event("process", begin, ev)
+        elif name == "request_admitted" and ev.get("request") is not None:
+            admitted_at[str(ev["request"])] = ev
+        elif name == "request_done" and ev.get("request") is not None:
+            begin = admitted_at.pop(str(ev["request"]), None)
+            if begin is not None:
+                slice_event("request", begin, ev)
+        # every milestone/instant is also a marker on its own track
+        out.append({"ph": "i", "pid": _PID, "tid": tracks.tid(_track_of(ev)),
+                    "name": name, "cat": "vft", "s": "t",
+                    "ts": us(ev["ts"]), "args": _args_of(ev)})
+
+    trace = {
+        "traceEvents": tracks.metadata() + out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "video_features_tpu.obs",
+            "events": len(timeline),
+            "paired_spans": paired,
+            "unpaired_spans": len(open_spans),
+        },
+    }
+    return trace
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m video_features_tpu.obs.export",
+        description="Convert a telemetry span journal (events.jsonl) into a "
+                    "Chrome/Perfetto trace (docs/observability.md)")
+    parser.add_argument("journal", help="path to the events.jsonl journal "
+                                        "(or the --telemetry_dir holding it)")
+    parser.add_argument("-o", "--output", default=None,
+                        help="trace output path (default: "
+                             "<journal>.trace.json)")
+    ns = parser.parse_args(argv)
+    path = ns.journal
+    if os.path.isdir(path):
+        path = os.path.join(path, "events.jsonl")
+    try:
+        events, corrupt = load_journal(path)
+    except OSError as e:
+        print(f"cannot read journal: {e}", file=sys.stderr)
+        return 2
+    trace = to_chrome_trace(events)
+    out_path = ns.output or (path + ".trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    meta = trace["otherData"]
+    spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    print(f"{out_path}: {meta['events']} journal events → {spans} spans "
+          f"({meta['paired_spans']} explicit, "
+          f"{meta['unpaired_spans']} unpaired)"
+          + (f"; {corrupt} corrupt line(s) skipped" if corrupt else "")
+          + " — load in https://ui.perfetto.dev or chrome://tracing")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

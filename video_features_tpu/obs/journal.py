@@ -1,0 +1,198 @@
+"""Span/event journal: a durable, non-blocking record of what happened when.
+
+Every record is one JSON line::
+
+    {"ts": 12345.678901, "event": "video_done", "model": "resnet50",
+     "video": "/abs/a.mp4"}
+
+``ts`` is ``time.monotonic()`` seconds — monotone within the process, immune
+to wall-clock steps; the writer's first record (``journal_open``) carries the
+``wall`` epoch anchor so exporters can map to wall time. Span events come in
+``<name>_start`` / ``<name>_end`` pairs sharing a ``span`` id; the exporter
+(:mod:`.export`) folds them into complete Chrome-trace slices.
+
+Discipline (the ``AsyncOutputWriter`` idea applied to telemetry): producers
+— the daemon loop, decode workers, the packer — call :meth:`SpanJournal.emit`
+which does a single non-blocking queue put. A full queue DROPS the event and
+counts the drop; the serving/extraction hot path never waits on telemetry
+disk. One writer thread owns the file; a failing disk degrades to counted
+``write_errors``, never an exception on a producer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+DEFAULT_CAPACITY = 4096
+JOURNAL_NAME = "events.jsonl"
+
+
+class SpanJournal:
+    """Bounded single-writer JSONL event journal (never blocks producers)."""
+
+    def __init__(self, path: str, capacity: int = DEFAULT_CAPACITY,
+                 autostart: bool = True):
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self.path = path
+        self._q: queue.Queue = queue.Queue(maxsize=max(capacity, 1))
+        # guards the producer-side counters (emit is called from the daemon
+        # loop, decode workers, and the output-writer reap concurrently)
+        self._lock = threading.Lock()
+        self.emitted = 0
+        self.dropped = 0
+        self._written = 0
+        self._write_errors = 0
+        self._spans = itertools.count(1)
+        # the open record carries construction-time stamps, not writer-
+        # thread start time: producers may emit before the thread is
+        # scheduled, and the journal must still sort open-first by ts
+        self._t0_mono = time.monotonic()
+        self._t0_wall = time.time()
+        self._closed = False
+        self._started = False
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="telemetry-journal")
+        if autostart:
+            self.start()
+
+    # --- producer side (any thread) ------------------------------------------
+
+    def emit(self, event: str, **fields) -> bool:
+        """Append one event record; returns False when it was dropped.
+
+        None-valued fields are omitted (callers pass optional context
+        unconditionally). Values must be JSON-friendly scalars/strings —
+        the writer serializes with ``default=str`` so a stray object
+        degrades to its repr rather than killing the record.
+        """
+        if self._closed:
+            return False
+        rec: Dict[str, object] = {"ts": round(time.monotonic(), 6),
+                                  "event": event}
+        for key, value in fields.items():
+            if value is not None:
+                rec[key] = value
+        try:
+            self._q.put_nowait(rec)
+        except queue.Full:
+            with self._lock:
+                self.dropped += 1
+            return False
+        with self._lock:
+            self.emitted += 1
+        return True
+
+    def begin(self, name: str, **fields) -> int:
+        """Open a span: emits ``<name>_start`` and returns the span id to
+        pass to :meth:`end`. For code whose control flow does not fit a
+        ``with`` block (e.g. the decode worker's try/finally ladder)."""
+        sid = next(self._spans)
+        self.emit(f"{name}_start", span=sid, **fields)
+        return sid
+
+    def end(self, name: str, sid: int, **fields) -> None:
+        self.emit(f"{name}_end", span=sid, **fields)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **fields):
+        """Emit a ``<name>_start`` / ``<name>_end`` pair around the body,
+        sharing a fresh ``span`` id — the exporter pairs them into one
+        complete trace slice. Yields the span id."""
+        sid = self.begin(name, **fields)
+        try:
+            yield sid
+        finally:
+            self.end(name, sid, **fields)
+
+    # --- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    @property
+    def written(self) -> int:
+        """Records the writer thread has landed on disk."""
+        return self._written
+
+    def stats(self) -> Dict[str, object]:
+        return {
+            "path": self.path,
+            "emitted": self.emitted,
+            "dropped": self.dropped,
+            "written": self._written,
+            "write_errors": self._write_errors,
+            "closed": self._closed,
+        }
+
+    def close(self, wait: bool = True) -> None:
+        """Stop accepting events; drain the queue and append the close
+        record (cumulative emitted/dropped counts) before returning."""
+        if self._closed:
+            return
+        self._closed = True
+        if not self._started:
+            self.start()  # someone must consume the backlog + sentinel
+        self._q.put(None)
+        if wait:
+            self._thread.join()
+
+    # --- writer thread --------------------------------------------------------
+
+    def _open_file(self):
+        try:
+            return open(self.path, "a", buffering=1)  # line-buffered
+        except OSError as e:
+            print(f"warning: telemetry journal disabled "
+                  f"(cannot open {self.path}): {e}", file=sys.stderr)
+            return None
+
+    def _drain(self) -> None:
+        f = self._open_file()
+
+        def write_rec(rec: dict) -> None:
+            """One record to disk; a failing disk counts, never raises."""
+            if f is None:
+                self._write_errors += 1  # thread-shared-state: written only by this single writer thread; readers see a monotone int (GIL-atomic load)
+                return
+            try:
+                f.write(json.dumps(rec, default=str) + "\n")
+            except (OSError, ValueError) as e:
+                self._write_errors += 1  # thread-shared-state: written only by this single writer thread; readers see a monotone int (GIL-atomic load)
+                if self._write_errors == 1:
+                    print(f"warning: telemetry journal write failed "
+                          f"({self.path}): {e}", file=sys.stderr)
+                return
+            self._written += 1  # thread-shared-state: written only by this single writer thread; readers see a monotone int (GIL-atomic load)
+
+        write_rec({"ts": round(self._t0_mono, 6), "event": "journal_open",
+                   "wall": round(self._t0_wall, 6), "pid": os.getpid()})
+        while True:
+            item = self._q.get()
+            if item is None:
+                break
+            write_rec(item)
+        with self._lock:
+            emitted, dropped = self.emitted, self.dropped
+        write_rec({"ts": round(time.monotonic(), 6), "event": "journal_close",
+                   "emitted": emitted, "dropped": dropped})
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass

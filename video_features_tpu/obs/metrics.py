@@ -1,0 +1,267 @@
+"""Metrics registry: named counters, gauges, and fixed-bucket histograms.
+
+The serving daemon's point-in-time ``stats`` op can say what the queues look
+like *now*; it cannot answer "why was tenant B's p99 bad at 14:00?". The
+registry accumulates the distributions that question needs — queue-wait,
+end-to-end latency, per-video decode/transfer seconds, per-batch device
+seconds — labeled by tenant and model, with p50/p95/p99 summaries and a
+Prometheus text exposition (the ``metrics`` socket op) for external scrapers.
+
+Histograms are fixed-bucket (Prometheus ``le`` semantics: bucket *i* counts
+values ``<= bounds[i]``, one overflow bucket past the last bound), so an
+observation is O(log buckets) and a snapshot is race-free arithmetic over
+monotone counters. Quantiles interpolate linearly inside the crossing bucket
+— exact at bucket boundaries, bounded by bucket width in between; the
+overflow bucket reports the last bound (the registry cannot know better).
+
+Thread posture: one lock covers all mutation and snapshotting. Producers are
+the daemon loop, the scheduler (ingest threads submit), the stage clock, and
+the packer; consumers are the socket API thread's ``stats``/``metrics`` ops.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+# Latency-shaped default bounds (seconds): sub-ms decode waits through
+# multi-minute flow videos. Shared by every histogram unless the first
+# observation names its own bounds.
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram with monotone cumulative-friendly counters."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Tuple[float, ...] = DEFAULT_BUCKETS):
+        bounds = tuple(float(b) for b in bounds)
+        if not bounds or list(bounds) != sorted(set(bounds)):
+            raise ValueError("histogram bounds must be sorted and distinct")
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # +1: overflow (> last bound)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        # Prometheus `le` semantics: bucket i counts value <= bounds[i]
+        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def bucket_index(self, value: float) -> int:
+        """The bucket a value lands in (tests assert ±1-bucket consistency
+        between journal-derived latencies and the live histogram)."""
+        return bisect.bisect_left(self.bounds, value)
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (0..1), linearly interpolated inside its bucket."""
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if c and cum >= rank:
+                if i >= len(self.bounds):  # overflow: no finite upper edge
+                    return self.bounds[-1]
+                lo = self.bounds[i - 1] if i else 0.0
+                hi = self.bounds[i]
+                frac = (rank - (cum - c)) / c
+                return lo + frac * (hi - lo)
+        return self.bounds[-1]
+
+    def snapshot(self) -> dict:
+        cum, buckets = 0, []
+        for bound, c in zip(self.bounds, self.counts):
+            cum += c
+            buckets.append([bound, cum])
+        buckets.append(["+Inf", cum + self.counts[-1]])
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "p50": round(self.quantile(0.50), 6),
+            "p95": round(self.quantile(0.95), 6),
+            "p99": round(self.quantile(0.99), 6),
+            "buckets": buckets,
+        }
+
+
+def _label_key(labels: Dict[str, object]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus exposition-format escaping for label VALUES: backslash,
+    double quote, and newline. Label values here include client-supplied
+    tenant names — one odd name must not corrupt the whole scrape."""
+    return (value.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + body + "}"
+
+
+def _fmt_value(v) -> str:
+    """Full-precision sample rendering (what prometheus_client does).
+
+    ``%g`` would quantize to 6 significant digits — a long-lived daemon's
+    monotone counter past 1e6 would read frozen between 10-unit quanta,
+    making ``rate()`` over the exposition show zero-then-burst."""
+    return repr(v) if isinstance(v, float) else str(v)
+
+
+class MetricsRegistry:
+    """Labeled counters/gauges/histograms behind one lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, tuple], float] = {}
+        self._gauges: Dict[Tuple[str, tuple], float] = {}
+        self._hists: Dict[Tuple[str, tuple], Histogram] = {}
+
+    # --- mutation -------------------------------------------------------------
+
+    def inc(self, name: str, value: float = 1.0, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        with self._lock:
+            self._gauges[(name, _label_key(labels))] = value
+
+    def observe(self, name: str, value: float,
+                buckets: Optional[Tuple[float, ...]] = None, **labels) -> None:
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram(buckets or DEFAULT_BUCKETS)
+            h.observe(value)
+
+    # --- reads ----------------------------------------------------------------
+
+    def counter_value(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get((name, _label_key(labels)), 0.0)
+
+    def histogram(self, name: str, **labels) -> Optional[Histogram]:
+        """The live histogram series (tests / consistency checks)."""
+        with self._lock:
+            return self._hists.get((name, _label_key(labels)))
+
+    def _copy_series(self):
+        """(counters, gauges, histogram copies), snapshotted under the lock.
+
+        Readers (``stats``/``metrics`` ops on the API thread) format OUTSIDE
+        the lock: producers observe from hot paths — including inside the
+        scheduler's queue lock — so a scrape holding this lock for a full
+        string-formatting pass would stall job pops and, transitively,
+        request admission. The copy is O(series); formatting is the
+        expensive part and runs lock-free on detached data.
+        """
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = [(n, lk, h.bounds, list(h.counts), h.sum, h.count)
+                     for (n, lk), h in sorted(self._hists.items())]
+        return counters, gauges, hists
+
+    @staticmethod
+    def _copied_hist(bounds, counts, hsum, count) -> Histogram:
+        h = Histogram(bounds)
+        h.counts = counts
+        h.sum = hsum
+        h.count = count
+        return h
+
+    def summaries(self, name: str) -> List[dict]:
+        """Per-label-set p50/p95/p99 rollup for one histogram family — the
+        shape the daemon's ``stats`` op embeds under ``latency``."""
+        _counters, _gauges, hists = self._copy_series()
+        out = []
+        for n, lk, bounds, counts, hsum, count in hists:
+            if n != name:
+                continue
+            h = self._copied_hist(bounds, counts, hsum, count)
+            out.append({"labels": dict(lk), "count": count,
+                        "sum": round(hsum, 6),
+                        "p50": round(h.quantile(0.50), 6),
+                        "p95": round(h.quantile(0.95), 6),
+                        "p99": round(h.quantile(0.99), 6)})
+        return out
+
+    def export(self, prefix: str = "vft_") -> Tuple[dict, str]:
+        """(structured snapshot, Prometheus text) from ONE series copy —
+        the ``metrics`` socket op serves both per call, and a second
+        independent copy would double the scrape's contention window
+        against hot-path producers (the scheduler observes inside its
+        queue lock)."""
+        series = self._copy_series()
+        return self._snapshot_from(series), self._text_from(series, prefix)
+
+    def snapshot(self) -> dict:
+        """JSON-friendly dump of every series (the ``metrics`` socket op)."""
+        return self._snapshot_from(self._copy_series())
+
+    @classmethod
+    def _snapshot_from(cls, series) -> dict:
+        counters, gauges, hists = series
+        return {
+            "counters": [
+                {"name": n, "labels": dict(lk), "value": round(v, 6)}
+                for (n, lk), v in counters],
+            "gauges": [
+                {"name": n, "labels": dict(lk), "value": v}
+                for (n, lk), v in gauges],
+            "histograms": [
+                {"name": n, "labels": dict(lk),
+                 **cls._copied_hist(bounds, cts, hsum, count).snapshot()}
+                for n, lk, bounds, cts, hsum, count in hists],
+        }
+
+    def prometheus_text(self, prefix: str = "vft_") -> str:
+        """Prometheus text exposition (one scrape-ready string); formatted
+        outside the registry lock (see :meth:`_copy_series`)."""
+        return self._text_from(self._copy_series(), prefix)
+
+    @staticmethod
+    def _text_from(series, prefix: str) -> str:
+        counters, gauges, hists = series
+        lines: List[str] = []
+        names_seen = set()
+        for (name, lk), value in counters:
+            full = prefix + name
+            if full not in names_seen:
+                names_seen.add(full)
+                lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full}{_label_str(lk)} {_fmt_value(value)}")
+        for (name, lk), value in gauges:
+            full = prefix + name
+            if full not in names_seen:
+                names_seen.add(full)
+                lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full}{_label_str(lk)} {_fmt_value(value)}")
+        for name, lk, bounds, counts, hsum, count in hists:
+            full = prefix + name
+            if full not in names_seen:
+                names_seen.add(full)
+                lines.append(f"# TYPE {full} histogram")
+            cum = 0
+            for bound, c in zip(bounds, counts):
+                cum += c
+                blk = _label_str(lk + (("le", f"{bound:g}"),))
+                lines.append(f"{full}_bucket{blk} {cum}")
+            blk = _label_str(lk + (("le", "+Inf"),))
+            lines.append(f"{full}_bucket{blk} {count}")
+            lines.append(f"{full}_sum{_label_str(lk)} {_fmt_value(hsum)}")
+            lines.append(f"{full}_count{_label_str(lk)} {count}")
+        return "\n".join(lines) + "\n"

@@ -72,6 +72,7 @@ from __future__ import annotations
 
 import heapq
 import sys
+import time
 from collections import Counter
 from dataclasses import dataclass
 from typing import (
@@ -230,7 +231,8 @@ class CorpusPacker:
 
     def __init__(self, spec: Optional[PackSpec] = None,
                  wait: Callable[[Any], np.ndarray] = np.asarray,
-                 clock=None, flush_age: int = 0, staging=None):
+                 clock=None, flush_age: int = 0, staging=None,
+                 journal=None, metrics=None):
         # model name -> PackSpec. Single-model callers (the batch loop, the
         # engine tests) pass one spec, registered under None; the multi-model
         # serving layer constructs spec-less and register_model()s each
@@ -243,6 +245,13 @@ class CorpusPacker:
         self._wait = wait
         self._clock = clock  # optional StageClock: packed_slots/packed_clips units
         self._flush_age = flush_age
+        # telemetry (docs/observability.md): the span journal gets a
+        # 'dispatch' instant per dispatched batch, a 'device' span around
+        # each batch fetch, and 'stale_flush' instants; the metrics registry
+        # gets per-bucket occupancy gauges and the device_batch_seconds
+        # histogram. Both optional and emit-only — never block dispatch.
+        self._journal = journal
+        self._metrics = metrics
         # optional HostStagingRing: the default (no-collate) batch assembly
         # fills a reusable per-geometry buffer instead of np.stack+pad_batch
         # allocating per dispatch; the buffer is committed against the step's
@@ -451,6 +460,14 @@ class CorpusPacker:
         if self._clock is not None:
             self._clock.add_units("packed_slots", batch_size)
             self._clock.add_units("packed_clips", len(slots))
+        if self._journal is not None:
+            self._journal.emit("dispatch", bucket=self._bucket_name(key),
+                               real_slots=len(slots), batch_slots=batch_size)
+        if self._metrics is not None:
+            self._metrics.set_gauge(
+                "bucket_occupancy",
+                round(stats["real_slots"] / stats["dispatched_slots"], 4),
+                bucket=self._bucket_name(key))
 
     def _stage_batch(self, clips: List[np.ndarray],
                      batch_size: int) -> np.ndarray:
@@ -471,9 +488,30 @@ class CorpusPacker:
             if inflight is None:
                 continue
             slots, row_of, out = inflight
-            host = self._wait(out)
+            host = self._fetch_batch(k, out)
             for i, slot in enumerate(slots):
                 slot.assembly.put(slot.idx, host[row_of[i]])
+
+    def _fetch_batch(self, key: tuple, out) -> np.ndarray:
+        """Fetch one batch's device output through the extractor's
+        device_wait-accounted ``_wait``, with the blocked time journaled as
+        a per-batch 'device' span and observed into the
+        ``device_batch_seconds`` histogram (labeled by model — the
+        per-BATCH device distribution; per-video device attribution does
+        not exist under packing, where a batch mixes videos)."""
+        if self._journal is None and self._metrics is None:
+            return self._wait(out)
+        t0 = time.perf_counter()
+        if self._journal is not None:
+            with self._journal.span("device", bucket=self._bucket_name(key)):
+                host = self._wait(out)
+        else:
+            host = self._wait(out)
+        if self._metrics is not None:
+            model = key[0] if key[0] is not None else "default"
+            self._metrics.observe("device_batch_seconds",
+                                  time.perf_counter() - t0, model=model)
+        return host
 
     def _flush_stale(self) -> None:
         """Anti-starvation: dispatch (and resolve) buckets whose partial
@@ -517,6 +555,12 @@ class CorpusPacker:
                 self._record_stale_failure(key, e)
                 continue
             self._bucket_stats[key]["stale_flushes"] += 1
+            if self._journal is not None:
+                self._journal.emit("stale_flush",
+                                   bucket=self._bucket_name(key))
+            if self._metrics is not None:
+                self._metrics.inc("stale_flushes_total",
+                                  bucket=self._bucket_name(key))
 
     def _record_stale_failure(self, key: tuple, e: BaseException) -> None:
         msg = (f"anti-starvation flush of bucket "

@@ -125,12 +125,19 @@ class DecodePrefetcher:
     _DONE = object()
 
     def __init__(self, open_fn: Callable, workers: int, max_buffered: int = 512,
-                 max_buffered_bytes: int = 512 << 20):
+                 max_buffered_bytes: int = 512 << 20, journal=None):
         if workers < 1:
             raise ValueError("decode workers must be >= 1")
         self._open = open_fn
         self._max = max_buffered
         self._max_bytes = max_buffered_bytes
+        # optional ..obs.SpanJournal: each worker wraps its video in a
+        # 'decode' span (emit is a non-blocking queue put — thread-safe and
+        # never the decode path's problem). The span covers the worker's full
+        # occupancy of a decode slot: open + frame production, INCLUDING time
+        # blocked on a full buffer (consumer backpressure) — it answers "what
+        # was this decode slot doing", not "how fast is cv2".
+        self._journal = journal
         self._slots: dict = {}  # scheduled, not yet consumed
         self._handed: dict = {}  # handed to a consumer via get(), not released
         self._stop = threading.Event()
@@ -212,6 +219,8 @@ class DecodePrefetcher:
             return self._stop.is_set() or slot["stop"].is_set()
 
         self._sem.acquire()  # at most `workers` videos decoding concurrently
+        sid = (self._journal.begin("decode", video=path)
+               if self._journal is not None else None)
         try:
             try:
                 if stopped():
@@ -259,6 +268,8 @@ class DecodePrefetcher:
                     except queue.Full:  # consumer will drain; retry
                         continue
         finally:
+            if sid is not None:
+                self._journal.end("decode", sid, video=path)
             # a shrink may have pre-claimed this permit as debt; the helper
             # settles debt before returning the permit to the pool
             self._release_permit()

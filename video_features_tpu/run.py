@@ -141,6 +141,20 @@ def main(argv=None) -> int:
             line += (f", {s['evictions']} evicted, "
                      f"{s['quarantined']} quarantined")
         print(line)
+    # --telemetry_dir: where the span journal landed and whether the bounded
+    # writer had to drop events (docs/observability.md)
+    journal = getattr(extractor, "_journal", None)
+    if journal is not None:
+        s = journal.stats()
+        line = (f"telemetry: {s['written']} event(s) journaled to "
+                f"{journal.path}")
+        if s["dropped"]:
+            line += f", {s['dropped']} dropped (bounded queue)"
+        if s["write_errors"]:
+            line += f", {s['write_errors']} write error(s)"
+        print(line)
+        print("  view:  python -m video_features_tpu.obs.export "
+              f"{journal.path}")
     failed = len(paths) - ok
     if failed:
         print(f"{failed} video(s) failed; classified records in "

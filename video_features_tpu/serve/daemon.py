@@ -37,6 +37,18 @@ quota/fairness charged per waiter, and a leader failure re-enqueues the
 waiters (next replay leads on its own retry budget) instead of charging a
 neighbour's fault to their breakers.
 
+Observability (docs/observability.md): the daemon always keeps a metrics
+registry — queue-wait / end-to-end latency / decode / transfer histograms
+labeled tenant × model, per-bucket occupancy gauges, stage counters mirrored
+from the service clock — served by the ``stats`` (p50/p99 summaries,
+``"schema": 1``) and ``metrics`` (full snapshot + Prometheus text) socket
+ops. With ``--telemetry_dir`` every request and video additionally gets a
+journaled lifecycle (admitted → queued → popped → decode → dispatch →
+device → done/failed, plus cache/coalesce/stale-flush/autoscale/breaker
+events) exportable as a Chrome/Perfetto trace; ``healthz`` reports liveness
++ staleness from the API thread, and the ``profile`` op drives an on-demand
+``jax.profiler`` session in the live daemon.
+
 With ``--serve_models`` (ROADMAP item 2) several feature types co-reside on
 ONE mesh: requests pick a model via their ``feature_type`` key (admission
 validates it against the loaded set and rejects unknown models with a clean
@@ -69,6 +81,7 @@ from ..io.output import (
     request_result_path,
     write_request_result,
 )
+from ..obs import MetricsRegistry
 from ..reliability import (
     TenantBreaker,
     TenantBreakerOpen,
@@ -80,6 +93,11 @@ from .autoscale import DecodeAutoscaler
 from .ingest import SPOOL_TENANTS_FILE, SocketAPI, SpoolWatcher
 from .request import RequestRejected, ServiceRequest, parse_request
 from .scheduler import RequestQueue
+
+# healthz `stale` threshold: the serving loop stamps every step (idle steps
+# included, ~poll_interval apart), so an age past this means the daemon
+# thread is stuck — wedged, or in a legitimately long first-traffic compile
+HEALTH_STALE_SEC = 10.0
 
 
 class ExtractionService:
@@ -110,11 +128,19 @@ class ExtractionService:
         for m in extras:
             resolve_model_defaults(derive_model_config(cfg, m)).validate()
         self._poll = poll_interval
-        # the service clock runs for the daemon's lifetime: decode/device
-        # attribution feeds the autoscaler and the stats op regardless of
-        # VFT_METRICS
-        extractor.clock = StageClock()
+        # telemetry (docs/observability.md): _open_run_resources opens the
+        # span journal (--telemetry_dir, may be None) and the metrics
+        # registry (always on under --serve — `stats`/`metrics` ops need it);
+        # the service clock runs for the daemon's lifetime and MIRRORS its
+        # per-stage seconds/bytes into the registry, so decode/device/
+        # transfer attribution feeds the autoscaler, the stats op, and the
+        # Prometheus exposition from one accumulator
         extractor._open_run_resources()
+        self.journal = extractor._journal
+        if extractor._metrics is None:  # a directly-constructed service
+            extractor._metrics = MetricsRegistry()
+        self.metrics = extractor._metrics
+        extractor.clock = StageClock(registry=self.metrics)
         # ``factory(model) -> Extractor`` overrides lazy co-model
         # construction (tests wire toy models); the default builds the real
         # extractor for the derived config, sharing the primary's mesh
@@ -124,7 +150,12 @@ class ExtractionService:
             primary_spec=spec)
         self.session = self.sessions
         self.packer = self.sessions.packer
-        self.queue = RequestQueue(default_quota=cfg.tenant_quota)
+        # the queue owns the queue-wait signal: it emits queued/popped
+        # journal events and feeds the queue_wait_seconds histogram + the
+        # per-tenant depth gauges (serve/scheduler.py)
+        self.queue = RequestQueue(default_quota=cfg.tenant_quota,
+                                  journal=self.journal,
+                                  metrics=self.metrics)
         self.breaker = TenantBreaker(cfg.tenant_max_failures)
         self.notify_dir = cfg.notify_dir or os.path.join(
             cfg.spool_dir or cfg.output_path, "results")
@@ -144,12 +175,24 @@ class ExtractionService:
         self._hup = threading.Event()
         self._idle_since: Optional[float] = None
         self._completed_requests = 0
+        # healthz liveness: the loop stamps _last_step every step(); the
+        # socket's healthz op reports the age so a wedged daemon thread is
+        # visible from the (still-responsive) API thread. An on-demand
+        # jax.profiler session (`profile` op) is tracked by its trace dir.
+        self._started = time.monotonic()
+        self._last_step = self._started
+        self._profiling: Optional[str] = None
         # terminal failures with no extractor to account them (a co-loaded
         # model whose lazy construction failed) — the exit code includes them
         self._service_failures = 0
         self._closed = False
         if cfg.spool_dir:
             self._load_tenants_config(initial=True)
+
+    def _emit(self, event: str, **fields) -> None:
+        """One journal event (no-op without --telemetry_dir; never blocks)."""
+        if self.journal is not None:
+            self.journal.emit(event, **fields)
 
     # --- submission (ingest threads + tests call these) ----------------------
 
@@ -203,6 +246,14 @@ class ExtractionService:
                     + ("…" if len(inflight) > 3 else ""))
             if to_queue:
                 self.queue.submit(request, videos=to_queue)
+            # after queue.submit: a quota rejection there must not leave an
+            # admitted event for a request that was never admitted (the
+            # per-video queued events landing µs earlier is harmless — the
+            # exporter anchors the request span on THIS event)
+            self._emit("request_admitted", request=request.request_id,
+                       tenant=request.tenant, model=ft,
+                       videos=len(request.videos), queued=len(to_queue),
+                       resumed=len(resumed))
             self._requests[request.request_id] = request
             for v in resumed:
                 request.done.append(os.path.abspath(v))
@@ -229,6 +280,8 @@ class ExtractionService:
         tenant = (payload or {}).get("tenant") if isinstance(payload, dict) \
             else None
         print(f"[serve] rejected {request_id}: {reason}")
+        self._emit("request_rejected", request=request_id, tenant=tenant,
+                   reason=reason[:200])
         try:
             write_request_result(self.notify_dir, request_id, {
                 "request_id": request_id,
@@ -246,6 +299,7 @@ class ExtractionService:
 
     def step(self) -> bool:
         """One scheduling step; True when it did video work."""
+        self._last_step = time.monotonic()  # healthz liveness stamp
         if self._hup.is_set():
             self._hup.clear()
             self.reload()
@@ -290,10 +344,18 @@ class ExtractionService:
         except Exception as e:  # noqa: BLE001 — fault-barrier: a model whose lazy construction fails (missing weights, invalid derived config) must fail ITS job cleanly, not kill the daemon serving the other models
             if not self._video_failed(path, e):
                 # terminal: no session exists to run the shared accounting,
-                # so record + count here (the exit code must stay honest)
+                # so record + count + journal here (the exit code must stay
+                # honest AND the journal's video_failed stream must agree
+                # with the failure counter — ex._fail, the usual emitter,
+                # never runs when no extractor exists)
                 print(f"[serve] cannot construct model {model!r} for "
                       f"{path}: {e}", file=sys.stderr)
                 self._service_failures += 1
+                err_class, transient = classify(e)
+                self._emit("video_failed", video=path, model=model,
+                           error_class=err_class, transient=transient)
+                self.metrics.inc("videos_failed_total", model=model,
+                                 error_class=err_class)
                 try:
                     record_failure(feature_output_dir(
                         self.cfg.output_path, model), path, e)
@@ -313,6 +375,15 @@ class ExtractionService:
             for j in self.queue.peek_jobs(max(pool.workers - 1, 0)):
                 self.sessions.schedule_decode(
                     j.path, j.feature_type or self.cfg.feature_type)
+        # per-video decode/transfer histograms: ingest pulls the clip stream
+        # synchronously on this thread, so the service clock's stage deltas
+        # over the ingest window are this video's attribution (approximate
+        # by construction — concurrent staging-ring commits land in whatever
+        # window is open — but the distribution is what capacity questions
+        # need, not per-video forensics)
+        clock = self.ex.clock
+        d0 = clock.seconds.get("decode", 0.0)
+        x0 = clock.seconds.get("transfer", 0.0)
         try:
             self.session.ingest(path, model, retries=0)
         except KeyboardInterrupt:
@@ -325,6 +396,14 @@ class ExtractionService:
             self.session.fail(path, model, e)
         finally:
             self.sessions.release_decode(path)
+            self.metrics.observe(
+                "decode_seconds",
+                max(clock.seconds.get("decode", 0.0) - d0, 0.0),
+                tenant=tenant, model=model)
+            self.metrics.observe(
+                "transfer_seconds",
+                max(clock.seconds.get("transfer", 0.0) - x0, 0.0),
+                tenant=tenant, model=model)
         self.session.emit_completed(reap_limit=1)
         return True
 
@@ -409,6 +488,9 @@ class ExtractionService:
             # identical extraction already in flight: park this job — the
             # leader's completion (or failure) re-enqueues it
             self.sessions.release_decode(path)
+            self._emit("coalesced", video=path,
+                       request=job.request.request_id,
+                       tenant=job.request.tenant, model=model)
             return True
         self._coalescer.lead(key, path)
         return False
@@ -436,6 +518,14 @@ class ExtractionService:
                 return
             if job.from_cache:
                 job.request.cache_hits += 1
+            # end-to-end latency: admission → outputs landed (requeues and
+            # write resolution included) — the per-tenant/per-model p50/p99
+            # the stats op reports and the journal's queued→done chain pins
+            self.metrics.observe(
+                "e2e_latency_seconds",
+                max(time.monotonic() - job.admitted_at, 0.0),
+                tenant=job.request.tenant,
+                model=job.feature_type or self.cfg.feature_type)
             job.request.done.append(path)
             self._maybe_finish_request(job.request)
 
@@ -481,6 +571,9 @@ class ExtractionService:
 
     def _fail_fast_tenant(self, tenant: str) -> None:
         """Breaker tripped: fail the tenant's queued videos without decoding."""
+        self._emit("breaker_open", tenant=tenant,
+                   failures=self.breaker.failures(tenant))
+        self.metrics.inc("breaker_trips_total", tenant=tenant)
         jobs = self.queue.drain_tenant(tenant)
         print(f"[serve] tenant {tenant!r} breaker OPEN "
               f"({self.breaker.failures(tenant)} terminal failures): "
@@ -505,6 +598,14 @@ class ExtractionService:
             print(f"warning: could not record failure for {job.path}: {e}",
                   file=sys.stderr)
         self.sessions.release_decode(job.path)  # may have been hint-scheduled
+        # fast failures skip the extractor's _fail (no decode, no attempt)
+        # so they journal AND count here — the lifecycle chain must still
+        # terminate and the failure counter must agree with the journal's
+        # video_failed stream during exactly the incident it exists for
+        self._emit("video_failed", video=job.path, model=model,
+                   error_class="TenantBreakerOpen", transient=False)
+        self.metrics.inc("videos_failed_total", model=model,
+                         error_class="TenantBreakerOpen")
         # a fast-failed ex-waiter still holds its consult-time cache key
         # (abspath-keyed, matching the memo — job.path is absolute by
         # admission, the abspath here is belt-and-braces)
@@ -533,6 +634,10 @@ class ExtractionService:
                   f"{request.request_id}: {e}", file=sys.stderr)
         self._requests.pop(request.request_id, None)
         self._completed_requests += 1
+        self._emit("request_done", request=request.request_id,
+                   tenant=request.tenant, state=record["state"],
+                   done=len(request.done), failed=len(request.failed))
+        self.metrics.inc("requests_total", state=record["state"])
         print(f"[serve] request {request.request_id} {record['state']}: "
               f"{len(request.done)} done, {len(request.failed)} failed")
         self._autoscale_tick()
@@ -556,7 +661,10 @@ class ExtractionService:
             print(f"[serve] decode autoscale: {pool.workers} → {new} "
                   f"worker(s) (interval occupancy {occupancy:.1%}, decode "
                   f"{decode - d0:.2f}s of {now - t0:.2f}s)")
+            self._emit("autoscale", workers_from=pool.workers, workers_to=new,
+                       occupancy=round(occupancy, 4))
             pool.resize(new)
+            self.metrics.set_gauge("decode_workers", new)
 
     def _quiescent(self) -> bool:
         with self._lock:
@@ -629,8 +737,13 @@ class ExtractionService:
         with self._lock:
             return {
                 "ok": True,
+                # payload version (docs/serving.md documents the field tree):
+                # external scrapers pin this and treat a bump as a breaking
+                # change; additive fields do not bump it
+                "schema": 1,
                 "feature_type": self.cfg.feature_type,
                 "serving_models": list(self.models),
+                "uptime_sec": round(time.monotonic() - self._started, 3),
                 "draining": self._draining.is_set(),
                 "live_requests": len(self._requests),
                 "in_flight_videos": len(self._jobs),
@@ -664,18 +777,113 @@ class ExtractionService:
                 "decode_workers": pool.workers if pool is not None else 0,
                 "tenants": self.queue.stats(),
                 "breaker_open": list(self.breaker.open_tenants()),
+                # per-tenant × per-model latency distributions (p50/p95/p99
+                # + counts) from the live histograms — the after-the-fact
+                # "why was tenant B's p99 bad?" answer the point-in-time
+                # counters above cannot give; full bucket detail is on the
+                # `metrics` op
+                "latency": {
+                    "e2e": self.metrics.summaries("e2e_latency_seconds"),
+                    "queue_wait": self.metrics.summaries(
+                        "queue_wait_seconds"),
+                },
+                "telemetry": (self.journal.stats() if self.journal is not None
+                              else {"enabled": False}),
             }
+
+    def healthz(self) -> dict:
+        """Liveness + staleness, served from the API thread WITHOUT the
+        service lock — a wedged daemon thread (or one stalled in a long
+        first-traffic compile) still answers, and ``last_step_age_sec`` is
+        how an operator tells the two apart. ``stale`` trips once the loop
+        has not stepped for :data:`HEALTH_STALE_SEC`; a legitimate cause
+        (a 60 s flow compile) looks identical to a wedge by design — both
+        mean "the daemon is not serving right now"."""
+        now = time.monotonic()
+        age = now - self._last_step
+        return {
+            "ok": True,
+            "schema": 1,
+            "uptime_sec": round(now - self._started, 3),
+            "last_step_age_sec": round(age, 3),
+            "stale": age > HEALTH_STALE_SEC,
+            "draining": self._draining.is_set(),
+            "profiling": self._profiling,
+        }
+
+    def _profile_op(self, action: str, trace_dir: Optional[str]) -> dict:
+        """On-demand ``jax.profiler`` session in the LIVE daemon (`profile`
+        op): start captures device/host activity from now, stop writes the
+        trace for TensorBoard/XProf. Runs on the API thread —
+        ``jax.profiler.start_trace`` is process-global, so it sees the
+        daemon thread's device work."""
+        import jax
+
+        if action == "start":
+            if self._profiling is not None:
+                return {"ok": False, "error": f"already profiling into "
+                                              f"{self._profiling}; stop first"}
+            trace_dir = trace_dir or self.cfg.profile_dir or (
+                os.path.join(self.cfg.telemetry_dir, "profile")
+                if self.cfg.telemetry_dir else None)
+            if not trace_dir:
+                return {"ok": False,
+                        "error": "no trace dir: pass {\"dir\": ...} or start "
+                                 "the daemon with --profile_dir/"
+                                 "--telemetry_dir"}
+            try:
+                os.makedirs(trace_dir, exist_ok=True)
+                jax.profiler.start_trace(trace_dir)
+            except Exception as e:  # noqa: BLE001 — fault-barrier: a profiler that cannot start (backend quirk, bad dir) must report, not kill the API thread serving the live daemon
+                return {"ok": False, "error": f"start_trace failed: {e}"}
+            self._profiling = trace_dir
+            self._emit("profile_start", dir=trace_dir)
+            return {"ok": True, "profiling": trace_dir}
+        if action == "stop":
+            if self._profiling is None:
+                return {"ok": False, "error": "not profiling; start first"}
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001 — fault-barrier: a failing stop (full trace disk mid-export) must report over the socket and stay RETRYABLE, not dead-end the op
+                # keep _profiling set: jax's global session is usually still
+                # live after a failed export, so a retried stop can succeed.
+                # If jax says there IS no session (export failed after the
+                # session ended), clear the flag so a fresh start works —
+                # either way the op recovers without a daemon restart.
+                if "not started" in str(e).lower() \
+                        or "no profile" in str(e).lower():
+                    self._profiling = None
+                return {"ok": False, "error": f"stop_trace failed: {e}"}
+            trace_dir, self._profiling = self._profiling, None
+            self._emit("profile_stop", dir=trace_dir)
+            return {"ok": True, "trace_dir": trace_dir}
+        return {"ok": False,
+                "error": "profile needs \"action\": \"start\" or \"stop\""}
 
     def handle_op(self, op: dict) -> dict:
         """Dispatch one socket-API operation (transport in :mod:`.ingest`)."""
         kind = op.get("op")
         if kind == "ping":
             return {"ok": True}
+        if kind == "healthz":
+            return self.healthz()
+        if kind == "metrics":
+            # full registry dump + Prometheus text exposition from ONE
+            # series copy: scrapers take the text, humans/tools the
+            # structured snapshot
+            snapshot, text = self.metrics.export()
+            return {"ok": True, "schema": 1,
+                    "metrics": snapshot, "prometheus": text}
+        if kind == "profile":
+            return self._profile_op(str(op.get("action", "")), op.get("dir"))
         if kind == "submit":
             try:
                 request = self.submit(op, request_id=op.get("request_id"),
                                       source="socket")
             except RequestRejected as e:
+                self._emit("request_rejected",
+                           request=op.get("request_id"),
+                           reason=str(e)[:200])
                 return {"ok": False, "error": str(e)}
             return {"ok": True, "request_id": request.request_id,
                     "state": request.state}

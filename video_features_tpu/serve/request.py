@@ -47,9 +47,16 @@ class VideoJob:
     queue's global admission counter (FIFO tiebreak within a tenant);
     ``from_cache`` marks a video served from the feature cache (zero device
     steps) so the request's result record can report its hit count.
+
+    ``admitted_at``/``queued_at`` are monotonic timestamps feeding the
+    telemetry histograms (docs/observability.md): ``admitted_at`` is fixed
+    at admission (end-to-end latency = done − admitted, requeues included),
+    while ``queued_at`` resets on every (re)queue so queue-wait measures the
+    CURRENT wait, not the sum over retries.
     """
 
-    __slots__ = ("path", "request", "seq", "attempts", "from_cache")
+    __slots__ = ("path", "request", "seq", "attempts", "from_cache",
+                 "admitted_at", "queued_at")
 
     def __init__(self, path: str, request: "ServiceRequest", seq: int = 0):
         self.path = path
@@ -57,6 +64,8 @@ class VideoJob:
         self.seq = seq
         self.attempts = 0
         self.from_cache = False
+        self.admitted_at = time.monotonic()
+        self.queued_at = self.admitted_at
 
     @property
     def deadline(self) -> Optional[float]:

@@ -22,12 +22,20 @@ decides *whose* video feeds the packer's bucket queues next:
 
 Thread-safe: ingest threads (:mod:`.ingest`) submit while the daemon's loop
 pops; one lock covers all state.
+
+Telemetry (docs/observability.md): the queue is where queue-wait is
+measurable, so it owns that signal end to end — every (re)queue and pop
+emits a journal lifecycle event (``video_queued`` / ``video_requeued`` /
+``video_popped``), each pop observes the job's wait into the
+``queue_wait_seconds`` histogram (labeled tenant × model), and per-tenant
+``queue_depth`` gauges track backlog. All emit-only and non-blocking.
 """
 
 from __future__ import annotations
 
 import heapq
 import threading
+import time
 from typing import Dict, List, Optional
 
 from .request import RequestRejected, ServiceRequest, VideoJob
@@ -53,8 +61,11 @@ class RequestQueue:
 
     def __init__(self, default_weight: float = DEFAULT_WEIGHT,
                  default_quota: int = DEFAULT_QUOTA,
-                 tenants: Optional[dict] = None):
+                 tenants: Optional[dict] = None,
+                 journal=None, metrics=None):
         self._lock = threading.Lock()
+        self._journal = journal  # ..obs.SpanJournal (emit-only) or None
+        self._metrics = metrics  # ..obs.MetricsRegistry or None
         self._default_weight = default_weight
         self._default_quota = default_quota
         self._overrides: Dict[str, dict] = {}
@@ -108,6 +119,33 @@ class RequestQueue:
             for name, t in self._tenants.items():
                 t.weight, t.quota = parsed.get(name, (new_weight, new_quota))
 
+    # --- telemetry (emit-only, non-blocking; module docstring) ---------------
+
+    def _note_queued(self, job: VideoJob, event: str) -> None:
+        if self._journal is not None:
+            r = job.request
+            self._journal.emit(event, video=job.path, request=r.request_id,
+                               tenant=r.tenant, model=r.feature_type)
+
+    def _note_popped(self, job: VideoJob) -> None:
+        r = job.request
+        if self._metrics is not None:
+            # queue-wait: admission (or last requeue) → this pop. The same
+            # definition the trace exporter derives from the journal's
+            # queued→popped pair, so the histogram and the trace cross-check
+            self._metrics.observe("queue_wait_seconds",
+                                  max(time.monotonic() - job.queued_at, 0.0),
+                                  tenant=r.tenant,
+                                  model=r.feature_type or "default")
+        if self._journal is not None:
+            self._journal.emit("video_popped", video=job.path,
+                               request=r.request_id, tenant=r.tenant,
+                               model=r.feature_type)
+
+    def _gauge_depth(self, t: _Tenant) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("queue_depth", len(t.heap), tenant=t.name)
+
     def _tenant(self, name: str) -> _Tenant:
         t = self._tenants.get(name)
         if t is None:
@@ -156,6 +194,8 @@ class RequestQueue:
                 heapq.heappush(t.heap, (*job.sort_key(), job))
                 self._queued_paths.add(path)
                 jobs.append(job)
+                self._note_queued(job, "video_queued")
+            self._gauge_depth(t)
             if was_idle:
                 # waking tenant joins at the scheduler clock: idle time is
                 # not banked credit against active tenants
@@ -183,6 +223,10 @@ class RequestQueue:
         was_idle = not t.heap
         heapq.heappush(t.heap, (*job.sort_key(), job))
         self._queued_paths.add(job.path)
+        # queue-wait restarts here; end-to-end (admitted_at) keeps running
+        job.queued_at = time.monotonic()
+        self._note_queued(job, "video_requeued")
+        self._gauge_depth(t)
         if was_idle:
             t.vtime = max(t.vtime, self._vclock)
 
@@ -200,6 +244,8 @@ class RequestQueue:
             self._queued_paths.discard(job.path)
             self._vclock = t.vtime
             t.vtime += 1.0 / t.weight
+            self._note_popped(job)
+            self._gauge_depth(t)
             return job
 
     def peek_jobs(self, n: int) -> List[VideoJob]:
@@ -222,6 +268,7 @@ class RequestQueue:
             t.heap.clear()
             for job in jobs:
                 self._queued_paths.discard(job.path)
+            self._gauge_depth(t)
             return jobs
 
     # --- introspection -------------------------------------------------------

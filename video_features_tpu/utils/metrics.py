@@ -26,6 +26,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import os
+import threading
 import time
 from typing import Callable, Dict, Iterable, Iterator, Optional
 
@@ -84,9 +85,22 @@ def decode_starvation_warning(occupancy: float, decode_seconds: float,
 
 
 class StageClock:
-    """Accumulates seconds per named stage."""
+    """Accumulates seconds per named stage.
 
-    def __init__(self):
+    Thread-safe: increments arrive from the run-loop/daemon thread
+    (``timed_iter``, ``stage``), the staging ring's commit hooks, and the
+    async writer's reap concurrently, so every mutation holds ``_lock`` — a
+    lost ``+=`` would silently skew the report and the starvation heuristic.
+
+    ``registry``/``labels``: an optional :class:`..obs.MetricsRegistry` that
+    every accumulation is mirrored into (``stage_seconds_total``,
+    ``stage_bytes_total``, ``stage_units_total``, labeled ``stage=<name>``
+    plus ``labels``) — the serving daemon's long-lived clock feeds the
+    ``metrics`` socket op and the Prometheus exposition through this seam
+    (docs/observability.md).
+    """
+
+    def __init__(self, registry=None, labels: Optional[Dict] = None):
         self.seconds: Dict[str, float] = collections.defaultdict(float)
         self.counts: Dict[str, int] = collections.defaultdict(int)
         # dimensionless counters (no time attached), e.g. the packed loop's
@@ -96,20 +110,33 @@ class StageClock:
         # derives stage throughput (MB/s) from bytes/seconds — decode MB/s is
         # the ingest-rate signal the starvation heuristic keys on
         self.bytes: Dict[str, int] = collections.defaultdict(int)
+        self._lock = threading.Lock()
+        self._registry = registry
+        self._labels = dict(labels) if labels else {}
+
+    def _feed(self, metric: str, stage: str, value) -> None:
+        if self._registry is not None:
+            self._registry.inc(metric, value, stage=stage, **self._labels)
 
     def add_units(self, name: str, n: int = 1) -> None:
         """Accumulate a dimensionless counter reported alongside the stages."""
-        self.units[name] += n
+        with self._lock:
+            self.units[name] += n
+        self._feed("stage_units_total", name, n)
 
     def add_seconds(self, name: str, seconds: float) -> None:
         """Attribute externally-measured blocked time to a stage (e.g. the
         staging ring's wait for a pending host→device copy)."""
-        self.seconds[name] += seconds
+        with self._lock:
+            self.seconds[name] += seconds
+        self._feed("stage_seconds_total", name, seconds)
 
     def add_bytes(self, name: str, n: int) -> None:
         """Attribute payload bytes to a stage measured via :meth:`stage`
         (timed_iter's ``bytes_of`` does this for iterator stages)."""
-        self.bytes[name] += n
+        with self._lock:
+            self.bytes[name] += n
+        self._feed("stage_bytes_total", name, n)
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -117,8 +144,20 @@ class StageClock:
         try:
             yield
         finally:
-            self.seconds[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.seconds[name] += dt
+                self.counts[name] += 1
+            self._feed("stage_seconds_total", name, dt)
+
+    # registry mirroring from timed_iter is batched: the iterator runs per
+    # FRAME on the decode hot path, and a per-item registry inc (label-key
+    # build + the registry lock, contended against the stats API thread)
+    # would tax exactly the path telemetry promises not to. The local dicts
+    # stay per-item-exact under _lock; the mirror flushes every N items and
+    # on generator exit (StopIteration, abandonment, GC close — the finally
+    # runs for all of them), so the registry lags by at most one flush.
+    _FEED_EVERY = 64
 
     def timed_iter(self, it: Iterable, name: str,
                    bytes_of: Optional[Callable] = None) -> Iterator:
@@ -128,34 +167,62 @@ class StageClock:
         the report can state the stage's throughput (e.g. decoded MB/s).
         """
         it = iter(it)
-        while True:
-            t0 = time.perf_counter()
-            try:
-                item = next(it)
-            except StopIteration:
-                self.seconds[name] += time.perf_counter() - t0
-                return
-            self.seconds[name] += time.perf_counter() - t0
-            self.counts[name] += 1
-            if bytes_of is not None:
-                self.bytes[name] += bytes_of(item)
-            yield item
+        pending_s = 0.0
+        pending_b = 0
+        pending_n = 0
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = next(it)
+                except StopIteration:
+                    dt = time.perf_counter() - t0
+                    with self._lock:
+                        self.seconds[name] += dt
+                    pending_s += dt
+                    return
+                dt = time.perf_counter() - t0
+                nbytes = bytes_of(item) if bytes_of is not None else 0
+                with self._lock:
+                    self.seconds[name] += dt
+                    self.counts[name] += 1
+                    if nbytes:
+                        self.bytes[name] += nbytes
+                pending_s += dt
+                pending_b += nbytes
+                pending_n += 1
+                if pending_n >= self._FEED_EVERY:
+                    self._feed("stage_seconds_total", name, pending_s)
+                    if pending_b:
+                        self._feed("stage_bytes_total", name, pending_b)
+                    pending_s, pending_b, pending_n = 0.0, 0, 0
+                yield item
+        finally:
+            if pending_s:
+                self._feed("stage_seconds_total", name, pending_s)
+            if pending_b:
+                self._feed("stage_bytes_total", name, pending_b)
 
     def report(self, label: str, wall: float) -> str:
+        with self._lock:
+            seconds = dict(self.seconds)
+            counts = dict(self.counts)
+            nbytes = dict(self.bytes)
+            units = dict(self.units)
         parts = [f"{label}: wall {wall:.2f}s"]
-        for name in sorted(self.seconds):
-            stage = f"{name} {self.seconds[name]:.2f}s/{self.counts[name]}"
-            if self.bytes.get(name) and self.seconds[name] > 0:
-                mbps = self.bytes[name] / self.seconds[name] / 1e6
+        for name in sorted(seconds):
+            stage = f"{name} {seconds[name]:.2f}s/{counts.get(name, 0)}"
+            if nbytes.get(name) and seconds[name] > 0:
+                mbps = nbytes[name] / seconds[name] / 1e6
                 stage += f" ({mbps:.1f} MB/s)"
             parts.append(stage)
-        accounted = sum(self.seconds.values())
+        accounted = sum(seconds.values())
         parts.append(f"overlapped/other {max(wall - accounted, 0.0):.2f}s")
-        for name in sorted(self.units):
-            parts.append(f"{name}={self.units[name]}")
-        if self.units.get("packed_slots"):
+        for name in sorted(units):
+            parts.append(f"{name}={units[name]}")
+        if units.get("packed_slots"):
             # packing-occupancy stage: real clips per dispatched device slot
-            occ = self.units["packed_clips"] / self.units["packed_slots"]
+            occ = units["packed_clips"] / units["packed_slots"]
             parts.append(f"pack_occupancy {occ:.1%}")
         return " | ".join(parts)
 
