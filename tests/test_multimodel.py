@@ -9,6 +9,7 @@ tests/test_packer.py (shared program shapes, trivial CPU compiles)."""
 import glob
 import json
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -16,6 +17,13 @@ import pytest
 import jax.numpy as jnp
 
 from test_packer import ToyPacked, _write_video
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.vftlint.locks import LockOrderWatch  # noqa: E402
+from tools.vftlint.rules.lock_order import LOCK_ORDER  # noqa: E402
 
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.extractors.base import derive_model_config
@@ -90,6 +98,22 @@ def _cfg(tmp_path, sub, **kw):
         output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"), **kw)
 
 
+# runtime LOCK_ORDER cross-check: every multi-model daemon test runs with
+# the named locks wrapped by vftlint's LockOrderWatch (see tests/
+# test_service.py — the multi-model layer shares the same lock topology,
+# and a violation only its traffic pattern provokes must fail HERE)
+_WATCHES = []
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_watched():
+    _WATCHES.clear()
+    yield
+    for watch in _WATCHES:
+        watch.assert_clean()
+    _WATCHES.clear()
+
+
 def _service(tmp_path, sub, **kw):
     kw.setdefault("serve_models", (SECOND,))
     cfg = _cfg(tmp_path, sub, serve=True, **kw)
@@ -99,7 +123,9 @@ def _service(tmp_path, sub, **kw):
         assert model == SECOND
         return ToyPackedB(derive_model_config(cfg, model))
 
-    return ExtractionService(ex, poll_interval=0.001, factory=factory)
+    svc = ExtractionService(ex, poll_interval=0.001, factory=factory)
+    _WATCHES.append(LockOrderWatch(LOCK_ORDER).instrument_service(svc))
+    return svc
 
 
 def _outputs(tmp_path, sub, model):

@@ -8,6 +8,7 @@ one trivial CPU compile)."""
 import glob
 import json
 import os
+import sys
 import threading
 import time
 
@@ -15,6 +16,13 @@ import numpy as np
 import pytest
 
 from test_packer import ToyPacked, _write_video
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.vftlint.locks import LockOrderWatch  # noqa: E402
+from tools.vftlint.rules.lock_order import LOCK_ORDER  # noqa: E402
 
 from video_features_tpu.config import ExtractionConfig
 from video_features_tpu.io.output import load_done_set
@@ -65,9 +73,27 @@ def _cfg(tmp_path, sub, **kw):
         output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"), **kw)
 
 
+# every daemon constructed through _service runs under a LockOrderWatch:
+# the named locks (service/queue/registry/clock/journal) are wrapped with
+# the runtime twin of vftlint's lock-order rule, and the autouse fixture
+# below asserts the declared LOCK_ORDER held for every acquisition the test
+# actually performed — the static table and reality cannot drift silently
+_WATCHES = []
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_watched():
+    _WATCHES.clear()
+    yield
+    for watch in _WATCHES:
+        watch.assert_clean()
+    _WATCHES.clear()
+
+
 def _service(tmp_path, sub, **kw):
     ex = ToyPacked(_cfg(tmp_path, sub, serve=True, **kw))
     svc = ExtractionService(ex, poll_interval=0.001)
+    _WATCHES.append(LockOrderWatch(LOCK_ORDER).instrument_service(svc))
     return svc
 
 
@@ -111,6 +137,46 @@ def test_two_tenant_daemon_matches_per_tenant_batch_runs(tmp_path, corpus):
         record = _result(svc, r.request_id)
         assert record["state"] == "done"
         assert len(record["done"]) == 2 and record["failed"] == []
+
+
+def test_lock_order_watch_sees_real_nesting(tmp_path, corpus):
+    """Instrumentation sanity for the runtime LOCK_ORDER cross-check: a
+    busy daemon run must actually exercise nested acquisitions (submit and
+    step nest the queue lock under the service lock), every observed edge
+    must run WITH the declared order, and no violation may be recorded.
+    A watch that silently saw nothing would make the autouse teardown
+    assertion vacuous — this test pins that it bites."""
+    svc = _service(tmp_path, "watched")
+    svc.submit({"tenant": "alice", "videos": corpus[:2]})
+    svc.request_drain()
+    assert svc.run() == 0
+    watch = _WATCHES[-1]
+    assert ("service", "queue") in watch.edges
+    rank = {name: i for i, name in enumerate(LOCK_ORDER)}
+    for outer, inner in watch.edges:
+        assert rank[outer] < rank[inner], (outer, inner)
+    assert watch.violations == []
+
+
+def test_status_answers_during_result_publish_window(tmp_path, corpus):
+    """Result records are written OUTSIDE the service lock; between a
+    request leaving _requests and its record landing on disk, status() must
+    answer from the in-memory record (never 'unknown request_id' for a
+    request that just completed) and submit() must still reject the id."""
+    svc = _service(tmp_path, "pubwin")
+    r = svc.submit({"tenant": "a", "videos": [corpus[0]]})
+    with svc._lock:
+        finished = svc._finish_request_locked(r, force=True)
+    # the publish-window state: popped from _requests, record not on disk
+    st = svc.status(r.request_id)
+    assert st["ok"] is True and st["state"] == "aborted"
+    with pytest.raises(RequestRejected):
+        svc.submit({"tenant": "a", "videos": [corpus[1]]},
+                   request_id=r.request_id)
+    svc._publish_result(finished)
+    st = svc.status(r.request_id)  # now served from the disk record
+    assert st["ok"] is True and st["state"] == "aborted"
+    svc.close()
 
 
 def test_idle_flush_completes_requests_without_drain(tmp_path, corpus):

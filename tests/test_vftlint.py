@@ -4,11 +4,16 @@ Two layers:
 
 - **tier-1 guard**: the full rule suite over this checkout returns zero
   findings (any unannotated regression in jit-purity / host-sync /
-  thread-shared-state / explicit-dtype / fault-barrier / fast-registry
-  fails this module);
+  thread-shared-state / explicit-dtype / fault-barrier / fast-registry /
+  lock-order / guarded-by / blocking-under-lock fails this module);
 - **fixture tests**: per rule, a seeded violation in a tmp tree fires and
   the annotated/clean form stays quiet — the acceptance contract that no
   rule is satisfied by blanket allowlisting.
+
+Also pinned here: the parse-once budget (every source parsed exactly once
+per run regardless of rule count, plus a generous wall-clock ceiling) and
+the :class:`LockOrderWatch` runtime shim the daemon tests wrap their named
+locks with.
 
 Pure AST work, no jax import, no compiles — registered in _FAST_MODULES.
 """
@@ -25,11 +30,13 @@ if REPO not in sys.path:
 
 from tools.vftlint import all_rules, run_lint  # noqa: E402
 from tools.vftlint.__main__ import main as vftlint_main  # noqa: E402
-from tools.vftlint.rules import fast_registry  # noqa: E402
+from tools.vftlint.locks import LockOrderWatch  # noqa: E402
+from tools.vftlint.rules import fast_registry, lock_order  # noqa: E402
 
 ALL_RULE_IDS = {
-    "explicit-dtype", "fast-registry", "fault-barrier",
-    "host-sync", "jit-purity", "thread-shared-state",
+    "blocking-under-lock", "explicit-dtype", "fast-registry",
+    "fault-barrier", "guarded-by", "host-sync", "jit-purity",
+    "lock-order", "thread-shared-state",
 }
 
 
@@ -528,6 +535,439 @@ def test_fast_registry_missing_conftest(tmp_path):
     write(tmp_path, "tests/test_a.py", "def test_x():\n    pass\n")
     found = lint(tmp_path, "fast-registry")
     assert any("registry is missing" in f for f in found)
+
+
+# ---- lock-order -----------------------------------------------------------
+
+TWO_LOCKS = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.Lock()
+"""
+A = "video_features_tpu/locky.py:S._a"
+B = "video_features_tpu/locky.py:S._b"
+
+
+def _locky(tmp_path, body):
+    # body joins TWO_LOCKS *inside* class S (8 = the class-body indent in
+    # the raw fixture string, which write() dedents by 4)
+    write(tmp_path, "video_features_tpu/locky.py",
+          TWO_LOCKS + textwrap.indent(textwrap.dedent(body), "        "))
+
+
+def test_lock_order_fires_on_inversion(tmp_path, monkeypatch):
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [B, A])
+    _locky(tmp_path, """
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+    """)
+    found = lint(tmp_path, "lock-order")
+    assert len(found) == 1 and "inversion" in found[0]
+    assert "S._a" in found[0] and "S._b" in found[0]
+
+
+def test_lock_order_quiet_when_order_matches(tmp_path, monkeypatch):
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [A, B])
+    _locky(tmp_path, """
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+    """)
+    assert lint(tmp_path, "lock-order") == []
+
+
+def test_lock_order_fires_on_cycle(tmp_path, monkeypatch):
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [A, B])
+    _locky(tmp_path, """
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+        def rev(self):
+            with self._b:
+                with self._a:
+                    pass
+    """)
+    found = lint(tmp_path, "lock-order")
+    assert any("cycle" in f for f in found)
+    assert any("inversion" in f for f in found)  # rev() also inverts
+
+
+def test_lock_order_follows_helper_calls(tmp_path, monkeypatch):
+    """Interprocedural: the nested acquisition lives two frames down."""
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [B, A])
+    _locky(tmp_path, """
+        def outer(self):
+            with self._a:
+                self._inner()
+
+        def _inner(self):
+            self._innermost()
+
+        def _innermost(self):
+            with self._b:
+                pass
+    """)
+    found = lint(tmp_path, "lock-order")
+    assert len(found) == 1 and "inversion" in found[0] and "via" in found[0]
+    # the declared direction is quiet
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [A, B])
+    assert lint(tmp_path, "lock-order") == []
+
+
+def test_lock_order_unordered_nesting_is_a_finding(tmp_path):
+    # no monkeypatch: the fixture locks have no LOCK_ORDER position, and
+    # nesting is exactly the moment a lock must be named and ordered
+    _locky(tmp_path, """
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+    """)
+    found = lint(tmp_path, "lock-order")
+    assert any("no LOCK_ORDER position" in f for f in found)
+
+
+def test_lock_order_self_deadlock_on_plain_lock(tmp_path, monkeypatch):
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [A, B])
+    _locky(tmp_path, """
+        def f(self):
+            with self._a:
+                with self._a:
+                    pass
+    """)
+    found = lint(tmp_path, "lock-order")
+    assert len(found) == 1 and "self-deadlock" in found[0]
+
+
+def test_lock_order_rlock_reentry_is_fine(tmp_path):
+    write(tmp_path, "video_features_tpu/locky.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._r = threading.RLock()
+
+            def f(self):
+                with self._r:
+                    with self._r:
+                        pass
+    """)
+    assert lint(tmp_path, "lock-order") == []
+
+
+def test_lock_order_annotation_suppresses(tmp_path, monkeypatch):
+    monkeypatch.setattr(lock_order, "LOCK_ORDER", [B, A])
+    _locky(tmp_path, """
+        def fwd(self):
+            with self._a:
+                # lock-order: teardown-only path; b's owner thread is joined
+                with self._b:
+                    pass
+    """)
+    assert lint(tmp_path, "lock-order") == []
+
+
+# ---- guarded-by -----------------------------------------------------------
+
+JOURNAL_OK = """
+    import threading
+
+    class SpanJournal:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.emitted = 0
+            self.dropped = 0
+
+        def emit(self, rec):
+            with self._lock:
+                self.emitted += 1
+                self.dropped += 0
+"""
+
+
+def test_guarded_by_quiet_on_locked_access(tmp_path):
+    write(tmp_path, "video_features_tpu/obs/journal.py", JOURNAL_OK)
+    assert lint(tmp_path, "guarded-by") == []
+
+
+def test_guarded_by_fires_on_off_lock_read(tmp_path):
+    write(tmp_path, "video_features_tpu/obs/journal.py", JOURNAL_OK + """
+        def stats(self):
+            return {"emitted": self.emitted}
+""")
+    found = lint(tmp_path, "guarded-by")
+    assert len(found) == 1
+    assert "self.emitted" in found[0] and "'journal'" in found[0]
+
+
+def test_guarded_by_fires_on_off_lock_dict_iteration(tmp_path):
+    write(tmp_path, "video_features_tpu/obs/metrics.py", """
+        import threading
+
+        class MetricsRegistry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._counters = {}
+                self._gauges = {}
+                self._hists = {}
+
+            def inc(self, k):
+                with self._lock:
+                    self._counters[k] = self._gauges.get(k, 0)
+                    self._hists[k] = 1
+
+            def snapshot(self):
+                return sorted(self._counters.items())
+    """)
+    found = lint(tmp_path, "guarded-by")
+    assert len(found) == 1 and "self._counters" in found[0]
+    assert "snapshot" in found[0]
+
+
+def test_guarded_by_locked_suffix_is_exempt(tmp_path):
+    write(tmp_path, "video_features_tpu/obs/journal.py", JOURNAL_OK + """
+        def stats_locked(self):
+            return self.emitted + self.dropped
+""")
+    assert lint(tmp_path, "guarded-by") == []
+
+
+def test_guarded_by_annotation_suppresses(tmp_path):
+    write(tmp_path, "video_features_tpu/obs/journal.py", JOURNAL_OK + """
+        def stats(self):
+            # guarded-by: GIL-atomic monotone int; off-by-one-moment is fine
+            return self.emitted
+""")
+    assert lint(tmp_path, "guarded-by") == []
+
+
+def test_guarded_by_reports_stale_declaration(tmp_path):
+    write(tmp_path, "video_features_tpu/obs/journal.py", """
+        import threading
+
+        class SpanJournal:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.emitted = 0
+
+            def emit(self):
+                with self._lock:
+                    self.emitted += 1
+    """)
+    found = lint(tmp_path, "guarded-by")
+    assert len(found) == 1
+    assert "stale" in found[0] and "self.dropped" in found[0]
+
+
+# ---- blocking-under-lock --------------------------------------------------
+
+MU = """
+    import threading
+    import time
+
+    class S:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._q = None
+"""
+
+
+def _blocky(tmp_path, body):
+    # body joins MU *inside* class S (see _locky)
+    write(tmp_path, "video_features_tpu/blocky.py",
+          MU + textwrap.indent(textwrap.dedent(body), "        "))
+
+
+def test_blocking_fires_on_sleep_under_lock(tmp_path):
+    _blocky(tmp_path, """
+        def bad(self):
+            with self._mu:
+                time.sleep(0.1)
+    """)
+    found = lint(tmp_path, "blocking-under-lock")
+    assert len(found) == 1 and "time.sleep()" in found[0]
+
+
+def test_blocking_quiet_outside_lock(tmp_path):
+    _blocky(tmp_path, """
+        def ok(self):
+            with self._mu:
+                x = 1
+            time.sleep(0.1)
+            return x
+    """)
+    assert lint(tmp_path, "blocking-under-lock") == []
+
+
+def test_blocking_follows_helper_calls(tmp_path):
+    _blocky(tmp_path, """
+        def bad(self):
+            with self._mu:
+                self._flush()
+
+        def _flush(self):
+            with open("/tmp/x") as f:
+                return f.read()
+    """)
+    found = lint(tmp_path, "blocking-under-lock")
+    assert len(found) == 1
+    assert "via S._flush" in found[0] and "open()" in found[0]
+
+
+def test_blocking_queue_put_vs_put_nowait(tmp_path):
+    _blocky(tmp_path, """
+        def bad(self, item):
+            with self._mu:
+                self._q.put(item)
+
+        def ok(self, item):
+            with self._mu:
+                self._q.put_nowait(item)
+    """)
+    found = lint(tmp_path, "blocking-under-lock")
+    assert len(found) == 1 and "queue .put()" in found[0]
+    assert "bad" in found[0]
+
+
+def test_blocking_device_sync_under_lock(tmp_path):
+    _blocky(tmp_path, """
+        def bad(self, feats):
+            with self._mu:
+                return self._wait(feats)
+    """)
+    found = lint(tmp_path, "blocking-under-lock")
+    assert len(found) == 1 and "._wait()" in found[0]
+
+
+def test_blocking_nested_def_is_not_under_the_lock(tmp_path):
+    """A def/lambda created under a lock runs later, lock-free."""
+    _blocky(tmp_path, """
+        def ok(self):
+            with self._mu:
+                def worker():
+                    time.sleep(1.0)
+                self._worker = worker
+    """)
+    assert lint(tmp_path, "blocking-under-lock") == []
+
+
+def test_blocking_annotation_suppresses(tmp_path):
+    _blocky(tmp_path, """
+        def shutdown(self):
+            with self._mu:
+                # blocking-under-lock: teardown path; no producer is live
+                time.sleep(0.01)
+    """)
+    assert lint(tmp_path, "blocking-under-lock") == []
+
+
+# ---- LockOrderWatch (runtime cross-check shim) -----------------------------
+
+
+def test_lock_order_watch_records_edges_and_violations():
+    import threading
+
+    watch = LockOrderWatch(["a", "b"])
+    la = watch.wrap(threading.Lock(), "a")
+    lb = watch.wrap(threading.Lock(), "b")
+    with la:
+        with lb:
+            pass
+    assert ("a", "b") in watch.edges and watch.violations == []
+    watch.assert_clean()
+    with lb:
+        with la:
+            pass
+    assert len(watch.violations) == 1
+    assert "'a' while holding 'b'" in watch.violations[0]
+    with pytest.raises(AssertionError):
+        watch.assert_clean()
+
+
+def test_lock_order_watch_rlock_reentry_is_not_an_edge():
+    import threading
+
+    watch = LockOrderWatch(["a"])
+    la = watch.wrap(threading.RLock(), "a")
+    with la:
+        with la:
+            pass
+    assert watch.edges == set() and watch.violations == []
+
+
+# ---- parse-once budget ----------------------------------------------------
+
+
+def test_sources_parsed_once_per_run(monkeypatch):
+    """9+ rules must not re-parse per rule: each file is constructed into a
+    SourceFile exactly once per run_lint call."""
+    import tools.vftlint.core as core
+
+    counts = {}
+    orig = core.SourceFile.__init__
+
+    def counting(self, root, rel):
+        counts[rel] = counts.get(rel, 0) + 1
+        orig(self, root, rel)
+
+    monkeypatch.setattr(core.SourceFile, "__init__", counting)
+    assert run_lint(REPO) == []
+    assert counts, "no sources scanned?"
+    multi = {rel: n for rel, n in counts.items() if n != 1}
+    assert multi == {}, f"re-parsed per rule: {multi}"
+
+
+def test_full_run_wall_clock_budget():
+    """The full 9-rule suite stays within a generous ceiling (the pre-lock-
+    rules baseline was ~1.2 s on this class of machine; the budget guards
+    against O(files x rules) parse regressions, not small constant cost)."""
+    import time
+
+    t0 = time.perf_counter()
+    run_lint(REPO)
+    assert time.perf_counter() - t0 < 6.0
+
+
+# ---- --format json / github ------------------------------------------------
+
+
+def test_cli_json_format(tmp_path, capsys):
+    import json
+
+    write(tmp_path, "video_features_tpu/models/m.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    assert vftlint_main(["--format", "json", str(tmp_path)]) == 1
+    data = json.loads(capsys.readouterr().out)
+    assert len(data) == 1
+    rec = data[0]
+    assert rec["file"] == "video_features_tpu/models/m.py"
+    assert rec["line"] == 2 and rec["rule"] == "explicit-dtype"
+    assert "dtype" in rec["message"]
+    assert rec["suppression"] == "# explicit-dtype: <reason>"
+
+
+def test_cli_json_clean_is_empty_array(capsys):
+    import json
+
+    assert vftlint_main(["--format", "json", REPO]) == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
+def test_cli_github_format(tmp_path, capsys):
+    write(tmp_path, "video_features_tpu/models/m.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    assert vftlint_main(["--format", "github", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert out.startswith("::error file=video_features_tpu/models/m.py,"
+                          "line=2,title=vftlint explicit-dtype::")
 
 
 # ---- framework ------------------------------------------------------------

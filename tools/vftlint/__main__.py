@@ -1,8 +1,9 @@
-"""CLI: ``python -m tools.vftlint [--rule ID ...] [--list-rules] [root]``."""
+"""CLI: ``python -m tools.vftlint [--rule ID ...] [--format F] [root]``."""
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from .core import all_rules, default_root, run_lint
@@ -19,6 +20,12 @@ def main(argv=None) -> int:
                         help="run only this rule (repeatable)")
     parser.add_argument("--list-rules", action="store_true",
                         help="list registered rules and exit")
+    parser.add_argument("--format", choices=("text", "json", "github"),
+                        default="text", dest="fmt",
+                        help="finding output: text (default), json "
+                             "(machine-readable array), github (workflow "
+                             "::error annotations — findings show inline "
+                             "on PRs)")
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
@@ -36,14 +43,33 @@ def main(argv=None) -> int:
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
-    for finding in findings:
-        print(finding)
+    if args.fmt == "json":
+        print(json.dumps([
+            {"file": f.path, "line": f.line, "rule": f.rule,
+             "message": f.message,
+             "suppression": f"# {f.rule}: <reason>"}
+            for f in findings], indent=2))
+    elif args.fmt == "github":
+        for f in findings:
+            # one annotation per finding; GitHub renders these inline on the
+            # PR diff (docs/static-analysis.md). Newlines would break the
+            # single-line command grammar — findings have none, but be safe.
+            msg = f.message.replace("\n", " ")
+            print(f"::error file={f.path},line={max(f.line, 1)},"
+                  f"title=vftlint {f.rule}::{msg}")
+    else:
+        for finding in findings:
+            print(finding)
     n_rules = len(args.rules) if args.rules else len(registry)
     if findings:
         print(f"vftlint: {len(findings)} finding(s) from {n_rules} rule(s)",
               file=sys.stderr)
         return 1
-    print(f"vftlint: clean — {n_rules} rule(s) over {root}")
+    if args.fmt == "text":
+        print(f"vftlint: clean — {n_rules} rule(s) over {root}")
+    else:
+        print(f"vftlint: clean — {n_rules} rule(s) over {root}",
+              file=sys.stderr)
     return 0
 
 

@@ -11,9 +11,12 @@ on the finding line or the line directly above it — a reasonless annotation is
 itself a finding, so the allowlist grammar cannot rot into blanket waivers.
 
 Rules subclass :class:`Rule` and register with :func:`register`; the runner
-(:func:`run_lint`) walks each rule's declared roots once, shares parsed
-:class:`SourceFile` objects across rules, and returns findings formatted
-``file:line rule-id message``. CLI entry: ``python -m tools.vftlint``.
+(:func:`run_lint`) walks every selected rule's roots up front, parses each
+source exactly once (:class:`SourceFile` objects are shared across rules, as
+are the derived analyses — jit-traced-function discovery and the lock model —
+via :meth:`SourceFile.traced` and the ``shared`` dict handed to
+:meth:`Rule.prepare`), and returns findings formatted ``file:line rule-id
+message``. CLI entry: ``python -m tools.vftlint``.
 """
 
 from __future__ import annotations
@@ -64,6 +67,18 @@ class SourceFile:
                     self.comments[tok.start[0]] = tok.string
         except (tokenize.TokenError, IndentationError):
             pass  # the AST parse error already reports this file
+        self._traced = None  # memoized tracing.traced_functions result
+
+    def traced(self):
+        """Memoized jit-traced FunctionDef discovery — jit-purity and
+        host-sync both need it, and with 9+ rules the shared pass must not
+        re-derive per consumer (tests/test_vftlint.py pins the budget)."""
+        if self._traced is None:
+            from .tracing import traced_functions
+
+            self._traced = (traced_functions(self.tree)
+                            if self.tree is not None else set())
+        return self._traced
 
     def annotation(self, rule_id: str, line: int) -> Optional[str]:
         """Reason text of a ``# <rule-id>: <reason>`` annotation covering
@@ -89,6 +104,12 @@ class Rule:
 
     def wants(self, rel: str) -> bool:
         return rel.endswith(".py")
+
+    def prepare(self, root: str, sources: Dict[str, "SourceFile"],
+                shared: Dict[str, object]) -> None:
+        """Called once per run, after every selected rule's sources parsed.
+        ``shared`` is a per-run scratch dict for analyses several rules
+        consume (the lock-discipline rules build one lock model here)."""
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         return ()
@@ -161,25 +182,37 @@ def run_lint(root: str,
     else:
         rules = [registry[k] for k in sorted(registry)]
 
+    # one shared parse pass: every file any selected rule wants is read and
+    # parsed exactly once, THEN the rules run over the shared SourceFiles —
+    # lint wall-clock stays O(files), not O(files × rules)
     sources: Dict[str, SourceFile] = {}
-    findings: List[Finding] = []
-    parse_reported = set()
+    per_rule_rels: List[Tuple[Rule, List[str]]] = []
     for rule in rules:
+        rels: List[str] = []
         for sub in rule.roots:
             for rel in _walk_py(root, sub):
                 if not rule.wants(rel):
                     continue
+                rels.append(rel)
                 if rel not in sources:
                     sources[rel] = SourceFile(root, rel)
-                src = sources[rel]
-                if src.parse_error is not None:
-                    if rel not in parse_reported:
-                        parse_reported.add(rel)
-                        findings.append(Finding(
-                            rel, src.parse_error.lineno or 0, "parse-error",
-                            f"cannot parse: {src.parse_error.msg}"))
-                    continue
-                findings.extend(rule.check_file(src))
+        per_rule_rels.append((rule, rels))
+    shared: Dict[str, object] = {}
+    for rule in rules:
+        rule.prepare(root, sources, shared)
+    findings: List[Finding] = []
+    parse_reported = set()
+    for rule, rels in per_rule_rels:
+        for rel in rels:
+            src = sources[rel]
+            if src.parse_error is not None:
+                if rel not in parse_reported:
+                    parse_reported.add(rel)
+                    findings.append(Finding(
+                        rel, src.parse_error.lineno or 0, "parse-error",
+                        f"cannot parse: {src.parse_error.msg}"))
+                continue
+            findings.extend(rule.check_file(src))
         findings.extend(rule.finalize(root))
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
 

@@ -1,0 +1,679 @@
+"""Lock model: discovery, naming, scopes, and the acquisition graph.
+
+The serving stack holds 9 locks across 8 modules; three recurring review
+findings — lock-order inversions, shared state touched off-lock, blocking
+work under a lock — are exactly the hazards the test suite cannot observe
+(a deadlock needs the losing interleaving; a lost ``+=`` loses once a year).
+This module builds the shared analysis the three lock-discipline rules
+consume (``lock-order``, ``guarded-by``, ``blocking-under-lock``):
+
+1. **discovery**: every ``threading.Lock/RLock/Condition()`` creation site,
+   found by AST shape — ``self._x = threading.Lock()`` inside a class, a
+   module-level ``_x = threading.Lock()``, or a dict-literal value
+   (``slot = {"lock": threading.Lock(), ...}``). Each site gets a stable
+   canonical id ``<rel>:<Class>.<attr>`` (or ``<rel>:<name>`` /
+   ``<rel>:<target>['<key>']``) and a friendly name via :data:`LOCK_NAMES`
+   — the "how we name locks" registry (docs/static-analysis.md).
+2. **scopes**: per function, a structural walk resolves ``with <lock>:``
+   blocks (and bare ``<lock>.acquire()`` calls) to discovered locks and
+   tracks the held set statement by statement. Nested ``def``/``lambda``
+   bodies are NOT under the enclosing lock at runtime and are scanned as
+   their own scopes.
+3. **the graph**: direct intra-package calls are resolved name-based, the
+   same trade :mod:`.tracing` makes — ``self.m()`` to the enclosing class's
+   methods, ``self.attr.m()`` / ``name.m()`` through an attribute→class map
+   (inferred from ``<x>.<attr> = ClassName(...)`` assignments, seeded by
+   :data:`ATTR_TYPE_SEEDS` for the wirings assignment inference cannot see),
+   bare names to module-level package functions. A fixpoint then yields each
+   function's MAY-acquire lock set and MAY-reach blocking sinks, so
+   ``with self._lock: self.queue.submit(...)`` produces the interprocedural
+   service→queue edge (and would surface a file write three calls down).
+   Unresolvable calls are silently not followed — the analysis under-
+   approximates through indirection, and the rules exist to keep the hot
+   lock scopes direct enough to analyze.
+
+:class:`LockOrderWatch` is the runtime cross-check: a test-only shim
+wrapping the named locks that asserts the declared ``LOCK_ORDER`` while the
+daemon tests actually run, so the static table and reality cannot drift
+silently (tests/test_service.py, tests/test_multimodel.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .tracing import dotted_name
+
+# callee class names that create a lock (matched on the last dotted part, so
+# both `threading.Lock()` and a `from threading import Lock` spelling count)
+LOCK_CLASSES = {"Lock", "RLock", "Condition"}
+
+# canonical creation site -> friendly name. Every lock that participates in
+# nesting must be named here (lock-order findings use these names, LOCK_ORDER
+# declares them, and LockOrderWatch wraps them at runtime). A lock missing
+# from this table keeps its canonical id as its name — fine for leaf locks,
+# but the lock-order rule insists on a declared name + order position the
+# moment it shows up in a nested acquisition.
+LOCK_NAMES: Dict[str, str] = {
+    "video_features_tpu/serve/daemon.py:ExtractionService._lock": "service",
+    "video_features_tpu/serve/scheduler.py:RequestQueue._lock": "queue",
+    "video_features_tpu/obs/metrics.py:MetricsRegistry._lock": "registry",
+    "video_features_tpu/obs/journal.py:SpanJournal._lock": "journal",
+    "video_features_tpu/utils/metrics.py:StageClock._lock": "clock",
+    "video_features_tpu/parallel/pipeline.py:DecodePrefetcher._resize_lock":
+        "resize",
+    "video_features_tpu/parallel/pipeline.py:slot['lock']": "slot",
+    "video_features_tpu/extractors/flow.py:ExtractFlow._precompile_lock":
+        "precompile",
+    "video_features_tpu/reliability/faults.py:_lock": "faults",
+}
+
+# attribute -> owning class, for the cross-module wirings that assignment
+# inference cannot type (`self.journal = extractor._journal` carries no
+# constructor). Inference from `<x>.<attr> = ClassName(...)` assignments
+# covers the rest (queue -> RequestQueue, breaker -> TenantBreaker, ...).
+ATTR_TYPE_SEEDS: Dict[str, str] = {
+    "journal": "SpanJournal",
+    "_journal": "SpanJournal",
+    "metrics": "MetricsRegistry",
+    "_metrics": "MetricsRegistry",
+    "_registry": "MetricsRegistry",
+}
+
+# ---------------------------------------------------------------------------
+# blocking sinks (syntactic): the blocking-under-lock rule's leaf set.
+# Matching is deliberately name-shaped, like the rest of vftlint: `open`
+# covers file I/O at its chokepoint (reads/writes happen on handles a lock
+# scope should never have opened), queue put/get count only on queue-ish
+# receivers so `dict.get` stays out, and `*_nowait` / `block=False` forms
+# are exempt by construction.
+
+_BLOCKING_DOTTED = {
+    "time.sleep",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "os.makedirs", "os.replace", "os.rename", "os.remove", "os.unlink",
+    "os.system",
+    "shutil.rmtree", "shutil.copyfile", "shutil.copy", "shutil.move",
+    "json.dump",  # dump writes a file; dumps is pure and not listed
+    "socket.create_connection",
+}
+_BLOCKING_BARE = {"open", "print", "input"}
+_SOCKET_METHODS = {"recv", "recvfrom", "sendall", "accept", "connect",
+                   "listen"}
+_DEVICE_SYNC_METHODS = {"_wait", "block_until_ready"}
+_QUEUE_METHODS = {"put", "get"}
+_QUEUEISH = {"q", "_q", "queue", "_queue", "inq", "outq"}
+
+
+def _receiver_token(node: ast.AST) -> Optional[str]:
+    """The last name component of a call receiver (`self._q` -> '_q',
+    `slot["q"]` -> 'q') for the queue-ish heuristic."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Subscript):
+        key = node.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return key.value
+    return None
+
+
+def _queueish(node: ast.AST) -> bool:
+    token = _receiver_token(node)
+    if token is None:
+        return False
+    token = token.lower()
+    return (token in _QUEUEISH or token.endswith("_q")
+            or token.endswith("queue"))
+
+
+def classify_sink(call: ast.Call) -> Optional[str]:
+    """A human-readable sink description when ``call`` may block, else None."""
+    name = dotted_name(call.func) or ""
+    if name in _BLOCKING_DOTTED:
+        return f"{name}()"
+    if isinstance(call.func, ast.Name) and name in _BLOCKING_BARE:
+        return f"{name}() [I/O]" if name == "open" else f"{name}()"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr in _DEVICE_SYNC_METHODS:
+        return f".{attr}() [device sync]"
+    if attr in _SOCKET_METHODS:
+        return f".{attr}() [socket]"
+    if attr == "wait" and not isinstance(call.func.value, ast.Constant):
+        return ".wait()"
+    if attr == "join":
+        token = (_receiver_token(call.func.value) or "").lower()
+        if "thread" in token or "proc" in token:
+            return ".join() [thread]"
+    if attr in _QUEUE_METHODS and _queueish(call.func.value):
+        for kw in call.keywords:
+            if (kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False):
+                return None
+        return f"queue .{attr}()"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# discovery + per-function summaries
+
+
+class LockSite:
+    """One discovered lock creation site."""
+
+    __slots__ = ("canonical", "name", "rel", "line", "kind", "cls", "attr",
+                 "form")
+
+    def __init__(self, canonical: str, rel: str, line: int, kind: str,
+                 cls: Optional[str], attr: str, form: str):
+        self.canonical = canonical
+        self.name = LOCK_NAMES.get(canonical, canonical)
+        self.rel = rel
+        self.line = line
+        self.kind = kind  # Lock | RLock | Condition
+        self.cls = cls
+        self.attr = attr
+        self.form = form  # attr | global | dictkey
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind == "RLock"
+
+
+class FnSummary:
+    """One function's lock-relevant facts (events carry the held set)."""
+
+    __slots__ = ("rel", "cls", "name", "line", "node", "qual",
+                 "acquire_events", "call_events", "sink_events", "all_calls",
+                 "events")
+
+    def __init__(self, rel: str, cls: Optional[str], name: str, line: int,
+                 node: ast.AST):
+        self.rel = rel
+        self.cls = cls
+        self.name = name
+        self.line = line
+        self.node = node
+        self.qual = f"{cls}.{name}" if cls else name
+        # (lock name, line, held-before tuple)
+        self.acquire_events: List[Tuple[str, int, Tuple[str, ...]]] = []
+        # (Call node, line, held tuple) — only calls made while >=1 lock held
+        self.call_events: List[Tuple[ast.Call, int, Tuple[str, ...]]] = []
+        # (sink description, line, held tuple) — every direct sink
+        self.sink_events: List[Tuple[str, int, Tuple[str, ...]]] = []
+        self.all_calls: List[ast.Call] = []
+        # ("stmt" | "expr", node, held tuple) — guarded-by consumes these
+        self.events: List[Tuple[str, ast.AST, Tuple[str, ...]]] = []
+
+
+def _is_lock_call(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func)
+    if name is None:
+        return None
+    last = name.rsplit(".", 1)[-1]
+    return last if last in LOCK_CLASSES else None
+
+
+def _walk_no_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk that does not descend into nested def/lambda/class bodies
+    (they execute later, outside the enclosing lock scope)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+class LockModel:
+    """The package-wide lock model (built once per lint run)."""
+
+    def __init__(self, root: str, sources: Dict[str, "object"],
+                 package_prefix: str = "video_features_tpu/"):
+        self.root = root
+        self.sites: List[LockSite] = []
+        # resolution indexes
+        self._by_cls_attr: Dict[Tuple[str, Optional[str], str], LockSite] = {}
+        self._by_attr: Dict[Tuple[str, str], List[LockSite]] = {}
+        self._by_global: Dict[Tuple[str, str], LockSite] = {}
+        self._by_dictkey: Dict[Tuple[str, str], List[LockSite]] = {}
+        self._by_name: Dict[str, LockSite] = {}
+        # call resolution indexes
+        self._module_funcs: Dict[str, List[FnSummary]] = {}
+        self._methods: Dict[Tuple[str, str], List[FnSummary]] = {}
+        self._attr_types: Dict[str, Set[str]] = {
+            k: {v} for k, v in ATTR_TYPE_SEEDS.items()}
+        self._class_names: Set[str] = set()
+        self.functions: List[FnSummary] = []
+        self._fns_by_rel: Dict[str, List[FnSummary]] = {}
+
+        trees = [(rel, src.tree) for rel, src in sorted(sources.items())
+                 if rel.startswith(package_prefix)
+                 and getattr(src, "tree", None) is not None]
+        for rel, tree in trees:
+            self._discover_locks(rel, tree)
+            self._index_classes(tree)
+        for rel, tree in trees:
+            self._infer_attr_types(tree)
+        for rel, tree in trees:
+            self._scan_functions(rel, tree)
+        self._fixpoint()
+
+    # -- discovery ----------------------------------------------------------
+
+    def _discover_locks(self, rel: str, tree: ast.AST) -> None:
+        def visit(node: ast.AST, cls: Optional[str], fn: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name, fn)
+                    continue
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    visit(child, cls, child.name)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AnnAssign)):
+                    targets = (child.targets if isinstance(child, ast.Assign)
+                               else [child.target])
+                    value = child.value
+                    kind = _is_lock_call(value)
+                    if kind:
+                        for t in targets:
+                            self._register(rel, t, kind, child.lineno, cls)
+                    elif isinstance(value, ast.Dict):
+                        base = (targets[0].id if targets and
+                                isinstance(targets[0], ast.Name) else None)
+                        for k, v in zip(value.keys, value.values):
+                            kd = _is_lock_call(v)
+                            if (kd and base and isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)):
+                                self._register_site(LockSite(
+                                    f"{rel}:{base}[{k.value!r}]", rel,
+                                    v.lineno, kd, None, k.value, "dictkey"))
+                visit(child, cls, fn)
+
+        visit(tree, None, None)
+
+    def _register(self, rel: str, target: ast.AST, kind: str, line: int,
+                  cls: Optional[str]) -> None:
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self" and cls):
+            self._register_site(LockSite(
+                f"{rel}:{cls}.{target.attr}", rel, line, kind, cls,
+                target.attr, "attr"))
+        elif isinstance(target, ast.Name):
+            self._register_site(LockSite(
+                f"{rel}:{target.id}", rel, line, kind, None, target.id,
+                "global"))
+
+    def _register_site(self, site: LockSite) -> None:
+        if site.canonical in {s.canonical for s in self.sites}:
+            return
+        self.sites.append(site)
+        self._by_name.setdefault(site.name, site)
+        if site.form == "attr":
+            self._by_cls_attr[(site.rel, site.cls, site.attr)] = site
+            self._by_attr.setdefault((site.rel, site.attr), []).append(site)
+        elif site.form == "global":
+            self._by_global[(site.rel, site.attr)] = site
+        else:
+            self._by_dictkey.setdefault((site.rel, site.attr), []).append(site)
+
+    def site_named(self, name: str) -> Optional[LockSite]:
+        return self._by_name.get(name)
+
+    def sites_in(self, rel: str) -> List[LockSite]:
+        return [s for s in self.sites if s.rel == rel]
+
+    # -- class / attr-type indexing -----------------------------------------
+
+    def _index_classes(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._class_names.add(node.name)
+
+    def _infer_attr_types(self, tree: ast.AST) -> None:
+        """`<x>.<attr> = ClassName(...)` types attr as ClassName for call
+        resolution (`self.queue = RequestQueue(...)` -> queue.submit
+        resolves into RequestQueue). Collisions widen the scan — the safe
+        direction for a linter."""
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            cname = (dotted_name(node.value.func) or "").rsplit(".", 1)[-1]
+            if cname not in self._class_names:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    self._attr_types.setdefault(t.attr, set()).add(cname)
+
+    # -- lock expression resolution ------------------------------------------
+
+    def resolve_lock_expr(self, expr: ast.AST, rel: str,
+                          cls: Optional[str]) -> Optional[str]:
+        """The lock NAME a `with <expr>:` / `<expr>.acquire()` holds, or
+        None when the expression is not a discovered lock."""
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                site = self._by_cls_attr.get((rel, cls, expr.attr))
+                if site is None:
+                    candidates = self._by_attr.get((rel, expr.attr), [])
+                    site = candidates[0] if len(candidates) == 1 else None
+                return site.name if site else None
+            return None
+        if isinstance(expr, ast.Name):
+            site = self._by_global.get((rel, expr.id))
+            return site.name if site else None
+        if isinstance(expr, ast.Subscript):
+            key = expr.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                candidates = self._by_dictkey.get((rel, key.value), [])
+                if len(candidates) == 1:
+                    return candidates[0].name
+        return None
+
+    # -- function scanning ----------------------------------------------------
+
+    def _scan_functions(self, rel: str, tree: ast.AST) -> None:
+        def visit(node: ast.AST, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    self._scan_fn(rel, cls, child)
+                    visit(child, cls)  # nested defs: their own scopes
+                else:
+                    visit(child, cls)
+
+        visit(tree, None)
+
+    def _scan_fn(self, rel: str, cls: Optional[str], fn) -> None:
+        s = FnSummary(rel, cls, fn.name, fn.lineno, fn)
+        self._block(fn.body, frozenset(), s)
+        self.functions.append(s)
+        self._fns_by_rel.setdefault(rel, []).append(s)
+        if cls:
+            self._methods.setdefault((cls, fn.name), []).append(s)
+        else:
+            self._module_funcs.setdefault(fn.name, []).append(s)
+
+    def _block(self, stmts, held: frozenset, s: FnSummary) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # separate runtime scope
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                h = set(held)
+                for item in st.items:
+                    lock = self.resolve_lock_expr(item.context_expr, s.rel,
+                                                  s.cls)
+                    if lock is not None:
+                        s.acquire_events.append(
+                            (lock, item.context_expr.lineno,
+                             tuple(sorted(h))))
+                        h.add(lock)
+                    else:
+                        self._exprs(item.context_expr, frozenset(h), s)
+                self._block(st.body, frozenset(h), s)
+            elif isinstance(st, ast.If):
+                self._exprs(st.test, held, s)
+                self._block(st.body, held, s)
+                self._block(st.orelse, held, s)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._exprs(st.iter, held, s)
+                self._block(st.body, held, s)
+                self._block(st.orelse, held, s)
+            elif isinstance(st, ast.While):
+                self._exprs(st.test, held, s)
+                self._block(st.body, held, s)
+                self._block(st.orelse, held, s)
+            elif isinstance(st, ast.Try):
+                self._block(st.body, held, s)
+                for handler in st.handlers:
+                    self._block(handler.body, held, s)
+                self._block(st.orelse, held, s)
+                self._block(st.finalbody, held, s)
+            else:
+                self._exprs(st, held, s)
+
+    def _exprs(self, node: ast.AST, held: frozenset, s: FnSummary) -> None:
+        held_t = tuple(sorted(held))
+        s.events.append(("stmt", node, held_t))
+        for sub in _walk_no_defs(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            s.all_calls.append(sub)
+            # `lock.acquire()` outside a with-statement is an acquisition
+            # for edge purposes (held-until-unknown; the repo uses `with`
+            # for every real lock, fixtures may not)
+            if (isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "acquire"):
+                lock = self.resolve_lock_expr(sub.func.value, s.rel, s.cls)
+                if lock is not None:
+                    s.acquire_events.append((lock, sub.lineno, held_t))
+                    continue
+            desc = classify_sink(sub)
+            if desc is not None:
+                s.sink_events.append((desc, sub.lineno, held_t))
+            elif held:
+                s.call_events.append((sub, sub.lineno, held_t))
+
+    # -- call resolution + fixpoint -------------------------------------------
+
+    def resolve_call(self, call: ast.Call, fn: FnSummary) -> List[FnSummary]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self._module_funcs.get(func.id, [])
+        if not isinstance(func, ast.Attribute):
+            return []
+        base = func.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            if fn.cls is None:
+                return []
+            return self._methods.get((fn.cls, func.attr), [])
+        recv_attr = None
+        if isinstance(base, ast.Attribute):
+            recv_attr = base.attr  # self.queue.submit -> 'queue'
+        elif isinstance(base, ast.Name):
+            recv_attr = base.id  # clock.stage -> 'clock'
+        if recv_attr is None:
+            return []
+        out: List[FnSummary] = []
+        for cname in self._attr_types.get(recv_attr, ()):
+            out.extend(self._methods.get((cname, func.attr), []))
+        return out
+
+    def _fixpoint(self) -> None:
+        """Transitive MAY-acquire locks and MAY-reach blocking sinks."""
+        self._callees: Dict[int, List[FnSummary]] = {}
+        for fn in self.functions:
+            callees: List[FnSummary] = []
+            for call in fn.all_calls:
+                callees.extend(self.resolve_call(call, fn))
+            self._callees[id(fn)] = callees
+        self.eff_locks: Dict[int, Set[str]] = {
+            id(fn): {l for l, _, _ in fn.acquire_events}
+            for fn in self.functions}
+        # sink -> shortest discovered via-chain of function quals
+        self.eff_sinks: Dict[int, Dict[str, Tuple[str, ...]]] = {
+            id(fn): {desc: () for desc, _, _ in fn.sink_events}
+            for fn in self.functions}
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                locks = self.eff_locks[id(fn)]
+                sinks = self.eff_sinks[id(fn)]
+                for callee in self._callees[id(fn)]:
+                    for lock in self.eff_locks[id(callee)]:
+                        if lock not in locks:
+                            locks.add(lock)
+                            changed = True
+                    for desc, chain in self.eff_sinks[id(callee)].items():
+                        new_chain = (callee.qual,) + chain
+                        if len(new_chain) > 4:
+                            new_chain = new_chain[:4]
+                        if (desc not in sinks
+                                or len(new_chain) < len(sinks[desc])):
+                            if sinks.get(desc) != new_chain:
+                                sinks[desc] = new_chain
+                                changed = True
+
+    # -- rule-facing queries ---------------------------------------------------
+
+    def functions_in(self, rel: str) -> List[FnSummary]:
+        return self._fns_by_rel.get(rel, [])
+
+    def callees(self, fn: FnSummary) -> List[FnSummary]:
+        return self._callees.get(id(fn), [])
+
+    def call_effect_locks(self, call: ast.Call,
+                          fn: FnSummary) -> Dict[str, str]:
+        """lock name -> callee qual that (transitively) acquires it."""
+        out: Dict[str, str] = {}
+        for callee in self.resolve_call(call, fn):
+            for lock in self.eff_locks[id(callee)]:
+                out.setdefault(lock, callee.qual)
+        return out
+
+    def call_effect_sinks(self, call: ast.Call,
+                          fn: FnSummary) -> Dict[str, Tuple[str, ...]]:
+        """sink description -> via-chain of function quals."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        for callee in self.resolve_call(call, fn):
+            for desc, chain in self.eff_sinks[id(callee)].items():
+                full = (callee.qual,) + chain
+                if desc not in out or len(full) < len(out[desc]):
+                    out[desc] = full
+        return out
+
+    def is_reentrant(self, name: str) -> bool:
+        site = self._by_name.get(name)
+        return site is not None and site.reentrant
+
+
+def shared_model(root: str, sources: Dict[str, object],
+                 shared: Dict[str, object]) -> LockModel:
+    """The per-run lock model (built once, shared by all three lock rules
+    via run_lint's ``shared`` dict — the parse-once discipline)."""
+    model = shared.get("lock-model")
+    if model is None:
+        model = LockModel(root, sources)
+        shared["lock-model"] = model
+    return model
+
+
+# ---------------------------------------------------------------------------
+# runtime cross-check (test-only)
+
+
+class LockOrderWatch:
+    """Assert the declared LOCK_ORDER on live locks during daemon tests.
+
+    ``instrument_service`` swaps the named locks of an ``ExtractionService``
+    (service/queue/registry/clock/journal) for recording proxies; every
+    acquisition checks the acquiring thread's held stack against the
+    declared order. Violations are recorded (and asserted empty by the test
+    teardown), observed (outer, inner) pairs land in ``edges`` so tests can
+    also prove the instrumentation saw real nesting. Reentrant
+    re-acquisition of the same lock is not an edge (the service lock is an
+    RLock).
+    """
+
+    def __init__(self, order: Sequence[str]):
+        self._rank = {name: i for i, name in enumerate(order)}
+        self._held = threading.local()
+        self.violations: List[str] = []
+        self.edges: Set[Tuple[str, str]] = set()
+
+    def wrap(self, lock, name: str) -> "_WatchedLock":
+        return _WatchedLock(self, lock, name)
+
+    def instrument_service(self, service) -> "LockOrderWatch":
+        service._lock = self.wrap(service._lock, "service")
+        service.queue._lock = self.wrap(service.queue._lock, "queue")
+        service.metrics._lock = self.wrap(service.metrics._lock, "registry")
+        clock = service.ex.clock
+        if clock is not None:
+            clock._lock = self.wrap(clock._lock, "clock")
+        if service.journal is not None:
+            service.journal._lock = self.wrap(service.journal._lock,
+                                              "journal")
+        return self
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def note_acquire(self, name: str) -> None:
+        stack = self._stack()
+        if name in stack:  # reentrant re-acquire: no new edge
+            stack.append(name)
+            return
+        rank = self._rank.get(name)
+        for held in stack:
+            if (held, name) not in self.edges:
+                self.edges.add((held, name))
+            held_rank = self._rank.get(held)
+            if (rank is not None and held_rank is not None
+                    and held_rank > rank):
+                self.violations.append(
+                    f"acquired '{name}' while holding '{held}' — LOCK_ORDER "
+                    f"declares '{name}' before '{held}'")
+        stack.append(name)
+
+    def note_release(self, name: str) -> None:
+        stack = self._stack()
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] == name:
+                del stack[i]
+                return
+
+    def assert_clean(self) -> None:
+        assert not self.violations, "\n".join(self.violations)
+
+
+class _WatchedLock:
+    """Proxy for one named lock: record order events, delegate the rest."""
+
+    def __init__(self, watch: LockOrderWatch, lock, name: str):
+        self._watch = watch
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, *args, **kwargs):
+        self._watch.note_acquire(self.name)
+        ok = self._lock.acquire(*args, **kwargs)
+        if not ok:
+            self._watch.note_release(self.name)
+        return ok
+
+    def release(self):
+        self._lock.release()
+        self._watch.note_release(self.name)
+
+    def locked(self):
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False

@@ -4,10 +4,13 @@ registry. New rules: add a module here, subclass ``Rule``, decorate with
 the full checklist, fixture tests included)."""
 
 from . import (  # noqa: F401
+    blocking_under_lock,
     explicit_dtype,
     fast_registry,
     fault_barrier,
+    guarded_by,
     host_sync,
     jit_purity,
+    lock_order,
     thread_shared_state,
 )

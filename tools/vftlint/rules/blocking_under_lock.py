@@ -1,0 +1,84 @@
+"""blocking-under-lock: no blocking work while holding any lock.
+
+A lock scope is a convoy: every thread that wants the lock waits for
+whatever the holder is doing. Disk writes, blocking queue puts, socket I/O,
+``time.sleep``, subprocess calls, and device syncs (``Extractor._wait`` /
+``block_until_ready``) all turn a microsecond critical section into a
+latency cliff — the PR 10 review's "registry reads copy under the lock and
+format outside it" finding, generalized. The rule flags:
+
+- a direct blocking sink (:func:`..locks.classify_sink`) lexically inside a
+  ``with <lock>:`` block — including ``print`` (stdout to a pipe blocks)
+  and ``open`` (the file-I/O chokepoint);
+- a call under a held lock whose callee MAY (transitively, through the
+  lock model's name-resolved call graph) reach a blocking sink — the
+  ``with self._lock: self._finish(...)`` three-frames-to-a-file-write
+  shape that hand review kept catching.
+
+Non-blocking forms are exempt by construction: ``put_nowait``/``get_nowait``
+and ``block=False`` queue ops (the journal's producer path), plus anything
+the model cannot resolve (indirection under-approximates; keep lock scopes
+direct). Suppress a deliberate block with ``# blocking-under-lock:
+<reason>`` on the offending line — and expect the review to ask why the
+work cannot move outside the lock instead.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from ..core import Finding, Rule, SourceFile, register
+from .. import locks as locks_mod
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    title = "no blocking sinks (I/O, sleep, queue waits) while a lock is held"
+    roots = ("video_features_tpu",)
+
+    def __init__(self) -> None:
+        self._model: Optional[locks_mod.LockModel] = None
+
+    def prepare(self, root, sources, shared) -> None:
+        self._model = locks_mod.shared_model(root, sources, shared)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        model = self._model
+        if model is None:
+            return ()
+        findings: List[Finding] = []
+        for fn in model.functions_in(src.rel):
+            for desc, line, held in fn.sink_events:
+                if not held:
+                    continue
+                if self.suppressed(src, line, findings):
+                    continue
+                findings.append(Finding(
+                    src.rel, line, self.id,
+                    f"blocking {desc} while '{fn.qual}' holds "
+                    f"{self._locks(held)} — move the blocking work outside "
+                    "the lock (snapshot under the lock, act after release)"))
+            for call, line, held in fn.call_events:
+                sinks = model.call_effect_sinks(call, fn)
+                if not sinks:
+                    continue
+                desc, chain = min(sinks.items(), key=lambda kv: len(kv[1]))
+                if self.suppressed(src, line, findings):
+                    continue
+                findings.append(Finding(
+                    src.rel, line, self.id,
+                    f"call under {self._locks(held)} reaches blocking "
+                    f"{desc} via {' -> '.join(chain)} — move the call "
+                    "outside the lock (snapshot under the lock, act after "
+                    "release)"))
+        return findings
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        self._model = None
+        return ()
+
+    @staticmethod
+    def _locks(held) -> str:
+        names = ", ".join(f"'{h}'" for h in held)
+        return f"lock {names}" if len(held) == 1 else f"locks {names}"

@@ -1,0 +1,171 @@
+"""guarded-by: declared shared attributes are only touched under their lock.
+
+PR 10's review caught ``StageClock`` losing ``+=`` increments (two threads,
+no lock) and the registry iterating a dict the daemon mutates; both bug
+classes are mechanical once the discipline is DECLARED. ``GUARDED_BY`` is
+the promotion of the informal thread-shared-state prose into a checked map:
+per multi-thread module, attribute site -> the name of the lock
+(:data:`..locks.LOCK_NAMES`) that guards it. Any read or write of a
+declared site — including iterating it, the snapshot-before-iterate class
+of bug — must be lexically inside a ``with <that lock>:`` block.
+
+Exemptions, in keeping with how the code is actually structured:
+
+- ``__init__`` bodies (construction happens-before publication);
+- functions whose name ends in ``_locked`` — the naming convention this
+  repo uses for helpers whose CONTRACT is "caller holds the lock"
+  (``RequestQueue._requeue_locked``); the suffix is the declaration, and
+  the lock-order rule still sees the callers' ``with`` blocks;
+- an explicit ``# guarded-by: <reason>`` annotation for deliberate
+  off-lock access (e.g. a GIL-atomic monotone-counter read that tolerates
+  an off-by-one-moment value).
+
+Enforcement is per module: a guarded attribute read from ANOTHER module
+goes through the owner's methods (or is a deliberate, documented dirty
+read — the daemon's stats peeks). Stale declarations (a site no longer
+touched anywhere in its module) are reported so the table cannot rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, register
+from .. import locks as locks_mod
+
+# module -> {attribute site: lock name}. Site grammar mirrors the
+# thread-shared-state table: `self.attr`, `<name>.attr`, `<name>['key']`,
+# or a bare module-global name.
+GUARDED_BY: Dict[str, Dict[str, str]] = {
+    "video_features_tpu/serve/daemon.py": {
+        "self._requests": "service",
+        "self._jobs": "service",
+        "self._done_sets": "service",
+        "self._completed_requests": "service",
+        "self._as_snapshot": "service",
+        "self._publishing": "service",
+    },
+    "video_features_tpu/serve/scheduler.py": {
+        "self._tenants": "queue",
+        "self._queued_paths": "queue",
+        "self._vclock": "queue",
+        "self._seq": "queue",
+        "self._overrides": "queue",
+        "self._default_weight": "queue",
+        "self._default_quota": "queue",
+        "t.heap": "queue",
+        "t.vtime": "queue",
+    },
+    "video_features_tpu/obs/metrics.py": {
+        "self._counters": "registry",
+        "self._gauges": "registry",
+        "self._hists": "registry",
+    },
+    "video_features_tpu/obs/journal.py": {
+        "self.emitted": "journal",
+        "self.dropped": "journal",
+    },
+    "video_features_tpu/utils/metrics.py": {
+        "self.seconds": "clock",
+        "self.counts": "clock",
+        "self.units": "clock",
+        "self.bytes": "clock",
+    },
+    "video_features_tpu/parallel/pipeline.py": {
+        "slot['bytes']": "slot",
+        "self._debt": "resize",
+    },
+    "video_features_tpu/extractors/flow.py": {
+        "self._precompiled": "precompile",
+    },
+    "video_features_tpu/reliability/faults.py": {
+        "_cached_spec": "faults",
+        "_rules": "faults",
+    },
+}
+
+
+def _site_of(node: ast.AST) -> Optional[str]:
+    """Canonical site string for an attribute/subscript/name access whose
+    base is a plain name, matching the GUARDED_BY grammar."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return f"{node.value.id}.{node.attr}"
+    if isinstance(node, ast.Subscript) and isinstance(node.value, ast.Name):
+        key = node.slice
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return f"{node.value.id}[{key.value!r}]"
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@register
+class GuardedByRule(Rule):
+    id = "guarded-by"
+    title = "declared shared attributes accessed only under their lock"
+    roots = ("video_features_tpu",)
+
+    def __init__(self) -> None:
+        self._model: Optional[locks_mod.LockModel] = None
+        self._observed: Dict[str, Set[str]] = {}
+
+    def prepare(self, root, sources, shared) -> None:
+        self._model = locks_mod.shared_model(root, sources, shared)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        decl = GUARDED_BY.get(src.rel)
+        if not decl or self._model is None:
+            return ()
+        findings: List[Finding] = []
+        observed = self._observed.setdefault(src.rel, set())
+        seen: Set[Tuple[int, str]] = set()
+        for fn in self._model.functions_in(src.rel):
+            exempt = (fn.name == "__init__" or fn.name.endswith("_locked"))
+            for _, node, held in fn.events:
+                for sub in locks_mod._walk_no_defs(node):
+                    site = _site_of(sub)
+                    if site is None or site not in decl:
+                        continue
+                    observed.add(site)
+                    if exempt or decl[site] in held:
+                        continue
+                    key = (sub.lineno, site)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    if self.suppressed(src, sub.lineno, findings):
+                        continue
+                    findings.append(Finding(
+                        src.rel, sub.lineno, self.id,
+                        f"'{fn.qual}' touches {site} outside 'with "
+                        f"<{decl[site]} lock>:' — GUARDED_BY declares "
+                        f"{site} guarded by '{decl[site]}' (take the lock, "
+                        "move the access into a *_locked helper, or "
+                        "annotate the deliberate dirty read)"))
+        return findings
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        model, self._model = self._model, None
+        observed, self._observed = self._observed, {}
+        findings: List[Finding] = []
+        for rel, decl in GUARDED_BY.items():
+            path = os.path.join(root, rel.replace("/", os.sep))
+            if not os.path.exists(path):
+                continue
+            for site in sorted(set(decl) - observed.get(rel, set())):
+                findings.append(Finding(
+                    rel, 0, self.id,
+                    f"GUARDED_BY declares {site} but the module never "
+                    "touches it — prune the stale declaration"))
+            if model is not None:
+                module_locks = {s.name for s in model.sites_in(rel)}
+                for site, lock in sorted(decl.items()):
+                    if lock not in module_locks:
+                        findings.append(Finding(
+                            rel, 0, self.id,
+                            f"GUARDED_BY guards {site} with lock '{lock}' "
+                            "but no such lock is created in this module — "
+                            "fix the declaration"))
+        return findings

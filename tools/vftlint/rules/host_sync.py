@@ -34,7 +34,7 @@ import re
 from typing import Iterable, List, Set
 
 from ..core import Finding, Rule, SourceFile, register
-from ..tracing import dotted_name, traced_functions, walk_body
+from ..tracing import dotted_name, walk_body
 
 # attribute names whose CALL yields a device value
 _STEP_ATTR = re.compile(r"(^|_)step(_|$)|(^|_)device_call$")
@@ -245,7 +245,7 @@ class HostSyncRule(Rule):
                 scanner.scan_block(node.body)
         # traced bodies anywhere: a host-materializing call mid-trace forces
         # concretization (or burns a constant) regardless of dataflow
-        for fn in traced_functions(src.tree):
+        for fn in src.traced():  # memoized: shared with jit-purity
             for node in walk_body(fn):
                 if not isinstance(node, ast.Call):
                     continue

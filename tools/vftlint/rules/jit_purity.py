@@ -16,7 +16,7 @@ import ast
 from typing import Iterable, List
 
 from ..core import Finding, Rule, SourceFile, register
-from ..tracing import dotted_name, traced_functions, walk_body
+from ..tracing import dotted_name, walk_body
 
 # builtins that are host effects wherever they appear in a traced body
 _BANNED_BUILTINS = {"print", "open", "input", "breakpoint"}
@@ -46,7 +46,7 @@ class JitPurityRule(Rule):
 
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         findings: List[Finding] = []
-        for fn in traced_functions(src.tree):
+        for fn in src.traced():  # memoized: shared with host-sync
             for node in walk_body(fn):
                 if not isinstance(node, ast.Call):
                     continue

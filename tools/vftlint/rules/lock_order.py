@@ -1,0 +1,199 @@
+"""lock-order: nested lock acquisitions follow one declared total order.
+
+Every multi-lock deadlock this stack can produce is a cycle in the
+lock-acquisition graph; a total order over the named locks makes cycles
+impossible BY CONSTRUCTION — as long as every nested acquisition respects
+it. The model (:mod:`..locks`) observes nesting both lexically
+(``with a: with b:``) and interprocedurally (``with self._lock:
+self.queue.submit(...)`` — submit acquires the queue lock three frames
+down), and this rule checks the resulting edges against ``LOCK_ORDER``:
+
+- an edge that runs AGAINST the declared order is an inversion (the
+  deadlock half of PR 9's "pop+register atomically under the service lock —
+  lock order matches submit" review finding, mechanized);
+- a cycle among observed edges is reported even when the locks involved are
+  unordered — two unordered locks nested both ways deadlock all the same;
+- an edge touching a lock with no LOCK_ORDER position (or no LOCK_NAMES
+  name) is itself a finding: nesting is exactly the moment a lock must be
+  named and ordered. Leaf locks that never nest need no position.
+- a nested acquisition of a NON-reentrant lock already held is a guaranteed
+  self-deadlock and is reported unconditionally.
+
+Suppress a deliberate edge with ``# lock-order: <reason>`` on the acquiring
+line. The runtime twin (:class:`..locks.LockOrderWatch`) asserts this same
+table against the live daemon in tests/test_service.py and
+tests/test_multimodel.py, so the declaration cannot drift from reality.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..core import Finding, Rule, SourceFile, register
+from .. import locks as locks_mod
+
+# The declared total order, outermost first — today's de-facto order:
+# the daemon's service lock is the outermost (ingest + serving loop), the
+# scheduler's queue lock nests under it, and the observability locks
+# (metrics registry, journal producer counters, stage clock) are leaves
+# acquired under either. The remaining locks never nest today; they hold
+# positions so the first nesting someone introduces is checked, not named
+# ad hoc.
+LOCK_ORDER: List[str] = [
+    "service",    # serve/daemon.py ExtractionService._lock (RLock)
+    "queue",      # serve/scheduler.py RequestQueue._lock
+    "registry",   # obs/metrics.py MetricsRegistry._lock
+    "journal",    # obs/journal.py SpanJournal._lock (producer counters)
+    "clock",      # utils/metrics.py StageClock._lock
+    "resize",     # parallel/pipeline.py DecodePrefetcher._resize_lock
+    "slot",       # parallel/pipeline.py decode slot['lock'] (byte cap)
+    "precompile",  # extractors/flow.py ExtractFlow._precompile_lock
+    "faults",     # reliability/faults.py module _lock
+]
+
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    title = "nested lock acquisitions respect the declared LOCK_ORDER"
+    roots = ("video_features_tpu",)
+
+    def __init__(self) -> None:
+        self._model: Optional[locks_mod.LockModel] = None
+        self._sources: Dict[str, SourceFile] = {}
+
+    def prepare(self, root, sources, shared) -> None:
+        self._model = locks_mod.shared_model(root, sources, shared)
+        self._sources = sources
+
+    # All analysis is cross-file (the graph is interprocedural), so the
+    # findings are emitted from finalize; check_file contributes nothing.
+
+    def finalize(self, root: str) -> Iterable[Finding]:
+        model, self._model = self._model, None
+        sources, self._sources = self._sources, {}
+        if model is None:
+            return []
+        findings: List[Finding] = []
+        # observed edge -> first witness (rel, line, via-note)
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+        def add_edge(outer: str, inner: str, rel: str, line: int,
+                     via: str) -> None:
+            if self._suppressed_at(sources, rel, line, findings):
+                return
+            edges.setdefault((outer, inner), (rel, line, via))
+
+        for fn in model.functions:
+            for lock, line, held in fn.acquire_events:
+                for h in held:
+                    if h == lock:
+                        if not model.is_reentrant(lock):
+                            if not self._suppressed_at(sources, fn.rel, line,
+                                                       findings):
+                                findings.append(Finding(
+                                    fn.rel, line, self.id,
+                                    f"'{fn.qual}' re-acquires non-reentrant "
+                                    f"lock '{lock}' it already holds — "
+                                    "guaranteed self-deadlock"))
+                        continue
+                    add_edge(h, lock, fn.rel, line, "direct")
+            for call, line, held in fn.call_events:
+                inner = model.call_effect_locks(call, fn)
+                for lock, via in inner.items():
+                    for h in held:
+                        if h == lock:
+                            if not model.is_reentrant(lock):
+                                if not self._suppressed_at(sources, fn.rel,
+                                                           line, findings):
+                                    findings.append(Finding(
+                                        fn.rel, line, self.id,
+                                        f"'{fn.qual}' holds non-reentrant "
+                                        f"'{lock}' and calls '{via}' which "
+                                        "may acquire it again — potential "
+                                        "self-deadlock"))
+                            continue
+                        add_edge(h, lock, fn.rel, line, f"via {via}()")
+
+        rank = {name: i for i, name in enumerate(LOCK_ORDER)}
+        for (outer, inner), (rel, line, via) in sorted(edges.items()):
+            missing = [l for l in (outer, inner) if l not in rank]
+            if missing:
+                for lock in missing:
+                    findings.append(Finding(
+                        rel, line, self.id,
+                        f"nested acquisition involves lock '{lock}' with no "
+                        "LOCK_ORDER position — name it in LOCK_NAMES "
+                        "(tools/vftlint/locks.py) and order it in LOCK_ORDER "
+                        "(tools/vftlint/rules/lock_order.py)"))
+                continue
+            if rank[outer] > rank[inner]:
+                findings.append(Finding(
+                    rel, line, self.id,
+                    f"lock-order inversion: '{inner}' acquired while "
+                    f"holding '{outer}' ({via}) — LOCK_ORDER declares "
+                    f"'{inner}' before '{outer}'"))
+        findings.extend(self._cycles(edges))
+        findings.extend(self._stale_order(root, model))
+        return findings
+
+    def _suppressed_at(self, sources: Dict[str, SourceFile], rel: str,
+                       line: int, findings: List[Finding]) -> bool:
+        src = sources.get(rel)
+        return src is not None and self.suppressed(src, line, findings)
+
+    def _cycles(self, edges) -> Iterable[Finding]:
+        """Cycles in the observed graph (deadlock risk even among locks
+        LOCK_ORDER does not rank)."""
+        graph: Dict[str, List[str]] = {}
+        for outer, inner in edges:
+            graph.setdefault(outer, []).append(inner)
+        findings: List[Finding] = []
+        reported = set()
+
+        def dfs(node: str, stack: List[str], on_stack: set) -> None:
+            on_stack.add(node)
+            stack.append(node)
+            for nxt in graph.get(node, ()):
+                if nxt in on_stack:
+                    cycle = tuple(stack[stack.index(nxt):]) + (nxt,)
+                    key = frozenset(cycle)
+                    if key not in reported:
+                        reported.add(key)
+                        rel, line, _ = edges[(node, nxt)]
+                        findings.append(Finding(
+                            rel, line, self.id,
+                            "lock-acquisition cycle "
+                            f"{' -> '.join(cycle)} — deadlock risk; break "
+                            "the cycle or re-order the acquisitions"))
+                else:
+                    dfs(nxt, stack, on_stack)
+            stack.pop()
+            on_stack.discard(node)
+
+        for node in sorted(graph):
+            dfs(node, [], set())
+        return findings
+
+    def _stale_order(self, root: str,
+                     model: locks_mod.LockModel) -> Iterable[Finding]:
+        """LOCK_ORDER entries whose lock no longer exists (only checked when
+        the declaring file is present in this root, so fixture trees are not
+        blamed for the repo's table)."""
+        import os
+
+        findings: List[Finding] = []
+        canon_by_name = {v: k for k, v in locks_mod.LOCK_NAMES.items()}
+        for name in LOCK_ORDER:
+            if model.site_named(name) is not None:
+                continue
+            canonical = canon_by_name.get(name)
+            if canonical is None:
+                continue
+            rel = canonical.split(":", 1)[0]
+            if os.path.exists(os.path.join(root, rel.replace("/", os.sep))):
+                findings.append(Finding(
+                    rel, 0, self.id,
+                    f"LOCK_ORDER names '{name}' ({canonical}) but no such "
+                    "lock is created there — prune the stale entry"))
+        return findings

@@ -70,6 +70,8 @@ class ExtractFlow(Extractor):
         self._viz_counter = 0  # --show_pred PNG fallback numbering
         self._async_copy_ok = True  # cleared on first missing-API probe
         # --precompile: geometries already warmed (or warming) in background
+        # (vftlint GUARDED_BY: _precompiled under the 'precompile' lock —
+        # the run loop and prior warmup threads race on membership)
         self._precompiled: set = set()
         self._precompile_lock = threading.Lock()
         # --pack_corpus: corpus bucket plan (PackSpec.prepare fills it from

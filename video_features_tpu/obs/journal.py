@@ -131,10 +131,15 @@ class SpanJournal:
         return self._written
 
     def stats(self) -> Dict[str, object]:
+        # emitted/dropped are lock-guarded producer counters (GUARDED_BY);
+        # _written/_write_errors are the single-writer-thread counters whose
+        # GIL-atomic monotone reads need no lock (SHARED_WRITES discipline)
+        with self._lock:
+            emitted, dropped = self.emitted, self.dropped
         return {
             "path": self.path,
-            "emitted": self.emitted,
-            "dropped": self.dropped,
+            "emitted": emitted,
+            "dropped": dropped,
             "written": self._written,
             "write_errors": self._write_errors,
             "closed": self._closed,

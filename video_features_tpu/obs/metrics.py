@@ -17,6 +17,9 @@ overflow bucket reports the last bound (the registry cannot know better).
 Thread posture: one lock covers all mutation and snapshotting. Producers are
 the daemon loop, the scheduler (ingest threads submit), the stage clock, and
 the packer; consumers are the socket API thread's ``stats``/``metrics`` ops.
+The series dicts are declared in vftlint's ``GUARDED_BY`` map under the
+``registry`` lock (docs/static-analysis.md), so an off-lock touch — or
+iterating them without snapshotting first — fails lint, not production.
 """
 
 from __future__ import annotations

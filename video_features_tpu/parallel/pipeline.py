@@ -205,7 +205,9 @@ class DecodePrefetcher:
             "meta": None,
             "err": None,
             "bytes": 0,  # buffered payload bytes (max_buffered_bytes bound)
-            "lock": threading.Lock(),  # guards the bytes counter
+            # guards the bytes counter (vftlint GUARDED_BY: slot['bytes']
+            # under the 'slot' lock)
+            "lock": threading.Lock(),
             "ready": threading.Event(),
             "stop": threading.Event(),  # per-video cancel (release())
         }

@@ -89,6 +89,9 @@ def _parse(spec: str) -> List[_Rule]:
     return rules
 
 
+# the parsed-rule cache below is guarded by this module lock (vftlint
+# GUARDED_BY: 'faults' lock) — fault_point fires from decode workers, the
+# daemon thread, and the run loop concurrently
 _lock = threading.Lock()
 _cached_spec: Optional[str] = None
 _rules: List[_Rule] = []

@@ -168,6 +168,10 @@ class ExtractionService:
         self._lock = threading.RLock()
         self._requests: Dict[str, ServiceRequest] = {}
         self._jobs: Dict[str, object] = {}  # abspath -> in-flight VideoJob
+        # completed requests whose result record is still being written
+        # (the write runs OUTSIDE the service lock): status() answers from
+        # here during the window, and submit() still rejects the id as live
+        self._publishing: Dict[str, dict] = {}
         # in-flight dedup (--cache_dir): identical (content, fingerprint)
         # misses run one extraction; touched only on the daemon thread
         self._coalescer = InflightCoalescer()
@@ -214,8 +218,20 @@ class ExtractionService:
                 f"{', '.join(self.models)}); start the daemon with "
                 "--serve_models to co-load it")
         request.feature_type = ft
+        # the resume manifest read is disk I/O — do it BEFORE taking the
+        # service lock; submitters on other ingest threads and the serving
+        # loop's pop all convoy on this lock (no blocking work under it)
+        done = self._resume_done(ft)
+        to_queue = request.videos
+        resumed = ()
+        if done:
+            resumed = tuple(v for v in request.videos
+                            if os.path.abspath(v) in done)
+            to_queue = tuple(v for v in request.videos
+                             if os.path.abspath(v) not in done)
         with self._lock:
-            if request.request_id in self._requests:
+            if (request.request_id in self._requests
+                    or request.request_id in self._publishing):
                 raise RequestRejected(
                     f"request_id {request.request_id!r} is already live")
             if self.breaker.tripped(request.tenant):
@@ -223,14 +239,6 @@ class ExtractionService:
                     f"tenant {request.tenant!r} breaker is open "
                     f"({self.breaker.failures(request.tenant)} terminal "
                     "failures); fix the inputs and SIGHUP-reload")
-            to_queue = request.videos
-            resumed = ()
-            done = self._resume_done(ft)
-            if done:
-                resumed = tuple(v for v in request.videos
-                                if os.path.abspath(v) in done)
-                to_queue = tuple(v for v in request.videos
-                                 if os.path.abspath(v) not in done)
             # the scheduler rejects duplicates against its QUEUED set; a
             # path that was already popped (ingested, rows/writes pending)
             # is only visible here — without this check a resubmission
@@ -257,21 +265,28 @@ class ExtractionService:
             self._requests[request.request_id] = request
             for v in resumed:
                 request.done.append(os.path.abspath(v))
-            print(f"[serve] accepted {request.request_id} "
-                  f"(tenant={request.tenant}, {len(to_queue)} queued"
-                  + (f", {len(resumed)} resumed" if resumed else "") + ")")
-            self._maybe_finish_request(request)
+            finished = self._finish_request_locked(request)
+        # result record + prints are blocking work: outside the lock
+        print(f"[serve] accepted {request.request_id} "
+              f"(tenant={request.tenant}, {len(to_queue)} queued"
+              + (f", {len(resumed)} resumed" if resumed else "") + ")")
+        self._publish_result(finished)
         return request
 
     def _resume_done(self, feature_type: str) -> frozenset:
-        """The model's done-manifest set (empty without --resume)."""
+        """The model's done-manifest set (empty without --resume). The memo
+        is service-lock-guarded; the manifest READ runs off-lock (disk I/O
+        never happens under the service lock), and a lost race between two
+        first submitters just loads the same set twice."""
         if not self.cfg.resume:
             return frozenset()
-        done = self._done_sets.get(feature_type)
+        with self._lock:
+            done = self._done_sets.get(feature_type)
         if done is None:
-            done = frozenset(load_done_set(feature_output_dir(
+            loaded = frozenset(load_done_set(feature_output_dir(
                 self.cfg.output_path, feature_type)))
-            self._done_sets[feature_type] = done
+            with self._lock:
+                done = self._done_sets.setdefault(feature_type, loaded)
         return done
 
     def reject(self, request_id: str, reason: str, source: str = "api",
@@ -423,8 +438,10 @@ class ExtractionService:
                 if not did:
                     time.sleep(self._poll)
             with self._lock:
-                for request in list(self._requests.values()):
-                    self._maybe_finish_request(request, force=True)
+                pending = [self._finish_request_locked(request, force=True)
+                           for request in list(self._requests.values())]
+            for finished in pending:
+                self._publish_result(finished)
         finally:
             self.close()
         return (0 if self.sessions.failures == 0
@@ -497,7 +514,7 @@ class ExtractionService:
 
     # --- bookkeeping (PackedSession callbacks; daemon thread) ----------------
 
-    def _release_waiters(self, path: str) -> None:
+    def _release_waiters_locked(self, path: str) -> None:
         """Leader ``path`` resolved: re-enqueue its coalesced waiters with
         their original admission seqs (replays do not go to the back). After
         a successful leader they replay as cache hits; after a failed one the
@@ -512,7 +529,7 @@ class ExtractionService:
 
     def _video_done(self, path: str) -> None:
         with self._lock:
-            self._release_waiters(path)
+            self._release_waiters_locked(path)
             job = self._jobs.pop(path, None)
             if job is None:
                 return
@@ -527,7 +544,8 @@ class ExtractionService:
                 tenant=job.request.tenant,
                 model=job.feature_type or self.cfg.feature_type)
             job.request.done.append(path)
-            self._maybe_finish_request(job.request)
+            finished = self._finish_request_locked(job.request)
+        self._publish_result(finished)
 
     def _video_failed(self, path: str, exc: BaseException) -> bool:
         """Claim a transient failure by re-enqueueing (returns True — the
@@ -539,8 +557,10 @@ class ExtractionService:
         under the same retry budget as a directly-failing video — an
         innocent tenant's video lost to a neighbour's poisoned batch must
         not count against that tenant's breaker."""
+        finished = trip_tenant = None
+        requeued = False
         with self._lock:
-            self._release_waiters(path)
+            self._release_waiters_locked(path)
             job = self._jobs.pop(path, None)
             if job is None:
                 return False
@@ -550,31 +570,42 @@ class ExtractionService:
             if (transient and job.attempts <= self.cfg.retries
                     and not self.breaker.tripped(request.tenant)):
                 self.packer.discard(path)
-                print(f"[serve] [{err_class}] attempt {job.attempts} failed "
-                      f"for {path}: {exc}; re-enqueued "
-                      f"({self.cfg.retries + 1 - job.attempts} attempt(s) "
-                      "left)")
                 self.queue.requeue(job)
-                return True
-            try:
-                exc.attempts = job.attempts  # manifest records real count
-            except AttributeError:
-                pass
-            request.failed.append({
-                "video": path, "error_class": err_class,
-                "transient": transient, "message": str(exc)[:500],
-            })
-            self._maybe_finish_request(request)
-            if self.breaker.record_failure(request.tenant):
-                self._fail_fast_tenant(request.tenant)
-            return False
+                requeued = True
+            else:
+                try:
+                    exc.attempts = job.attempts  # manifest records attempts
+                except AttributeError:
+                    pass
+                request.failed.append({
+                    "video": path, "error_class": err_class,
+                    "transient": transient, "message": str(exc)[:500],
+                })
+                finished = self._finish_request_locked(request)
+                if self.breaker.record_failure(request.tenant):
+                    # breaker state + queue drain stay atomic with the
+                    # terminal count (submit checks tripped() under this
+                    # lock); the per-job manifests + prints run after release
+                    trip_tenant = request.tenant
+                    trip_jobs = self.queue.drain_tenant(request.tenant)
+        if requeued:
+            print(f"[serve] [{err_class}] attempt {job.attempts} failed "
+                  f"for {path}: {exc}; re-enqueued "
+                  f"({self.cfg.retries + 1 - job.attempts} attempt(s) "
+                  "left)")
+            return True
+        self._publish_result(finished)
+        if trip_tenant is not None:
+            self._fail_fast_tenant(trip_tenant, trip_jobs)
+        return False
 
-    def _fail_fast_tenant(self, tenant: str) -> None:
-        """Breaker tripped: fail the tenant's queued videos without decoding."""
+    def _fail_fast_tenant(self, tenant: str, jobs) -> None:
+        """Breaker tripped: fail the tenant's already-drained queued videos
+        without decoding (called with NO lock held — each fast failure
+        writes a manifest line)."""
         self._emit("breaker_open", tenant=tenant,
                    failures=self.breaker.failures(tenant))
         self.metrics.inc("breaker_trips_total", tenant=tenant)
-        jobs = self.queue.drain_tenant(tenant)
         print(f"[serve] tenant {tenant!r} breaker OPEN "
               f"({self.breaker.failures(tenant)} terminal failures): "
               f"failing {len(jobs)} queued video(s) fast; new submissions "
@@ -618,22 +649,43 @@ class ExtractionService:
                 "video": job.path, "error_class": "TenantBreakerOpen",
                 "transient": False, "message": str(exc)[:500],
             })
-            self._maybe_finish_request(job.request)
+            finished = self._finish_request_locked(job.request)
+        self._publish_result(finished)
 
-    def _maybe_finish_request(self, request: ServiceRequest,
-                              force: bool = False) -> None:
+    def _finish_request_locked(self, request: ServiceRequest,
+                               force: bool = False):
+        """Pop a completed request and build its result record (service lock
+        HELD — callers pass the return to :meth:`_publish_result` after
+        releasing). None when the request is still live."""
         if not request.complete and not force:
-            return
+            return None
         record = request.result_record()
         if force and not request.complete:
             record["state"] = "aborted"  # drain unwound before completion
+        self._requests.pop(request.request_id, None)
+        # stay visible to status()/submit() until the record write lands —
+        # a client polling the instant after completion must never see
+        # "unknown request_id" for a request that just succeeded
+        self._publishing[request.request_id] = record
+        self._completed_requests += 1
+        return (request, record)
+
+    def _publish_result(self, finished) -> None:
+        """Write + announce one finished request's record (NO lock held —
+        the record write is disk I/O, and submitters on the ingest threads
+        convoy on the service lock). Once a request left ``_requests`` its
+        done/failed lists are final: no job references it, so reading them
+        here is race-free; ``_publishing`` keeps it answerable meanwhile."""
+        if finished is None:
+            return
+        request, record = finished
         try:
             write_request_result(self.notify_dir, request.request_id, record)
         except Exception as e:  # noqa: BLE001 — fault-barrier: the notification is advisory; outputs + manifests already landed
             print(f"[serve] could not write result for "
                   f"{request.request_id}: {e}", file=sys.stderr)
-        self._requests.pop(request.request_id, None)
-        self._completed_requests += 1
+        with self._lock:
+            self._publishing.pop(request.request_id, None)
         self._emit("request_done", request=request.request_id,
                    tenant=request.tenant, state=record["state"],
                    done=len(request.done), failed=len(request.failed))
@@ -643,25 +695,33 @@ class ExtractionService:
         self._autoscale_tick()
 
     def _autoscale_tick(self) -> None:
-        """Between requests: act on the interval's decode-starvation signal."""
+        """Between requests: act on the interval's decode-starvation signal.
+        Measure + snapshot swap + decide run under the service lock as one
+        unit (request completions land from the daemon thread AND submit-
+        time all-resumed completions from ingest threads — a torn interval
+        would regress the snapshot and double-apply a resize step); decide()
+        is pure arithmetic, so only the print and the internally-locked
+        ``pool.resize`` stay outside."""
         pool = self.sessions.decode_pool
         if self._autoscaler is None or pool is None:
             return
-        now = time.perf_counter()
-        decode = self.ex.clock.seconds.get("decode", 0.0)
-        real, slots = self.packer.real_slots, self.packer.dispatched_slots
-        t0, d0, r0, s0 = self._as_snapshot
-        self._as_snapshot = (now, decode, real, slots)
-        d_slots = slots - s0
-        occupancy = (real - r0) / d_slots if d_slots else 1.0
-        new = self._autoscaler.decide(occupancy, decode - d0, now - t0,
-                                      pool.workers,
-                                      dispatched_slots=d_slots)
-        if new != pool.workers:
-            print(f"[serve] decode autoscale: {pool.workers} → {new} "
+        with self._lock:
+            now = time.perf_counter()
+            decode = self.ex.clock.seconds.get("decode", 0.0)
+            real, slots = self.packer.real_slots, self.packer.dispatched_slots
+            t0, d0, r0, s0 = self._as_snapshot
+            self._as_snapshot = (now, decode, real, slots)
+            d_slots = slots - s0
+            occupancy = (real - r0) / d_slots if d_slots else 1.0
+            current = pool.workers
+            new = self._autoscaler.decide(occupancy, decode - d0, now - t0,
+                                          current,
+                                          dispatched_slots=d_slots)
+        if new != current:
+            print(f"[serve] decode autoscale: {current} → {new} "
                   f"worker(s) (interval occupancy {occupancy:.1%}, decode "
                   f"{decode - d0:.2f}s of {now - t0:.2f}s)")
-            self._emit("autoscale", workers_from=pool.workers, workers_to=new,
+            self._emit("autoscale", workers_from=current, workers_to=new,
                        occupancy=round(occupancy, 4))
             pool.resize(new)
             self.metrics.set_gauge("decode_workers", new)
@@ -698,6 +758,11 @@ class ExtractionService:
                         "videos": len(request.videos),
                         "done": len(request.done),
                         "failed": len(request.failed)}
+            publishing = self._publishing.get(request_id)
+            if publishing is not None:
+                # completed, record write still in flight: answer from the
+                # in-memory record rather than racing the disk
+                return {"ok": True, **publishing}
         path = request_result_path(self.notify_dir, request_id)
         if os.path.exists(path):
             try:

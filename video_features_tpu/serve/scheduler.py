@@ -91,13 +91,17 @@ class RequestQueue:
             raise ValueError("tenant config must be a JSON object")
         default = tenants_cfg.get("default") or {}
         overrides = dict(tenants_cfg.get("tenants") or {})
+        # the current defaults are queue-lock-guarded state (GUARDED_BY):
+        # snapshot them under the lock, parse outside it
+        with self._lock:
+            cur_weight, cur_quota = self._default_weight, self._default_quota
         # parse + validate EVERYTHING before mutating: a bad tenants.json at
         # SIGHUP must leave the previous config fully intact (the daemon
         # catches ValueError and keeps serving), never a half-applied one —
         # TypeError from a null/str value must not escape the catch either
         try:
-            new_weight = float(default.get("weight", self._default_weight))
-            new_quota = int(default.get("quota", self._default_quota))
+            new_weight = float(default.get("weight", cur_weight))
+            new_quota = int(default.get("quota", cur_quota))
             parsed = {
                 name: (float((ov or {}).get("weight", new_weight)),
                        int((ov or {}).get("quota", new_quota)))
@@ -142,11 +146,11 @@ class RequestQueue:
                                request=r.request_id, tenant=r.tenant,
                                model=r.feature_type)
 
-    def _gauge_depth(self, t: _Tenant) -> None:
+    def _gauge_depth_locked(self, t: _Tenant) -> None:
         if self._metrics is not None:
             self._metrics.set_gauge("queue_depth", len(t.heap), tenant=t.name)
 
-    def _tenant(self, name: str) -> _Tenant:
+    def _tenant_locked(self, name: str) -> _Tenant:
         t = self._tenants.get(name)
         if t is None:
             ov = self._overrides.get(name) or {}
@@ -172,7 +176,7 @@ class RequestQueue:
         if videos is None:
             videos = request.videos
         with self._lock:
-            t = self._tenant(request.tenant)
+            t = self._tenant_locked(request.tenant)
             if self._pending_locked(t) + len(videos) > t.quota:
                 raise RequestRejected(
                     f"tenant {request.tenant!r} over quota: "
@@ -195,7 +199,7 @@ class RequestQueue:
                 self._queued_paths.add(path)
                 jobs.append(job)
                 self._note_queued(job, "video_queued")
-            self._gauge_depth(t)
+            self._gauge_depth_locked(t)
             if was_idle:
                 # waking tenant joins at the scheduler clock: idle time is
                 # not banked credit against active tenants
@@ -219,14 +223,14 @@ class RequestQueue:
                 self._requeue_locked(job)
 
     def _requeue_locked(self, job: VideoJob) -> None:
-        t = self._tenant(job.request.tenant)
+        t = self._tenant_locked(job.request.tenant)
         was_idle = not t.heap
         heapq.heappush(t.heap, (*job.sort_key(), job))
         self._queued_paths.add(job.path)
         # queue-wait restarts here; end-to-end (admitted_at) keeps running
         job.queued_at = time.monotonic()
         self._note_queued(job, "video_requeued")
-        self._gauge_depth(t)
+        self._gauge_depth_locked(t)
         if was_idle:
             t.vtime = max(t.vtime, self._vclock)
 
@@ -245,7 +249,7 @@ class RequestQueue:
             self._vclock = t.vtime
             t.vtime += 1.0 / t.weight
             self._note_popped(job)
-            self._gauge_depth(t)
+            self._gauge_depth_locked(t)
             return job
 
     def peek_jobs(self, n: int) -> List[VideoJob]:
@@ -268,7 +272,7 @@ class RequestQueue:
             t.heap.clear()
             for job in jobs:
                 self._queued_paths.discard(job.path)
-            self._gauge_depth(t)
+            self._gauge_depth_locked(t)
             return jobs
 
     # --- introspection -------------------------------------------------------

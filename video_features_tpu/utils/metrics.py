@@ -91,6 +91,11 @@ class StageClock:
     (``timed_iter``, ``stage``), the staging ring's commit hooks, and the
     async writer's reap concurrently, so every mutation holds ``_lock`` — a
     lost ``+=`` would silently skew the report and the starvation heuristic.
+    The accumulator dicts are declared under the ``clock`` lock in vftlint's
+    ``GUARDED_BY`` map (docs/static-analysis.md), which mechanizes exactly
+    that bug class within this module; the daemon's cross-module
+    ``clock.seconds.get`` peeks are deliberate dirty reads of defaultdict
+    floats, documented at their sites.
 
     ``registry``/``labels``: an optional :class:`..obs.MetricsRegistry` that
     every accumulation is mirrored into (``stage_seconds_total``,
