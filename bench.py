@@ -1070,7 +1070,7 @@ def main() -> None:
                  for i in range(n_videos)])
             batch = 4 if on_cpu else 64
 
-            def service_cfg(sub):
+            def service_cfg(sub, **kw):
                 # not the shared cfg() helper: the daemon and the baseline
                 # need DISTINCT output trees (the shared one would dedupe
                 # the second run via its done-manifest)
@@ -1078,7 +1078,7 @@ def main() -> None:
                     feature_type="resnet50", batch_size=batch,
                     pack_corpus=True, on_extraction="save_numpy",
                     output_path=os.path.join("/tmp/vft_bench", sub),
-                    tmp_path=os.path.join("/tmp/vft_bench", "tmp"))
+                    tmp_path=os.path.join("/tmp/vft_bench", "tmp"), **kw)
 
             ex_b = ExtractResNet50(service_cfg("svc_batch"))
 
@@ -1092,7 +1092,14 @@ def main() -> None:
 
             shutil.rmtree(os.path.join("/tmp/vft_bench", "svc_serve"),
                           ignore_errors=True)  # fresh manifests per sweep
-            ex_s = ExtractResNet50(service_cfg("svc_serve"))
+            # admission WAL on, with the production fsync-batching window:
+            # the serving number carries the durability tax (docs/serving.md
+            # "Crash recovery" budgets it under 2% of wall)
+            ex_s = ExtractResNet50(service_cfg(
+                "svc_serve",
+                wal_path=os.path.join("/tmp/vft_bench", "svc_serve",
+                                      "admission.wal"),
+                wal_fsync_sec=0.05))
             svc = ExtractionService(ex_s, poll_interval=0.005)
             requests = [corpus[i:i + per_request]
                         for i in range(0, len(corpus), per_request)]
@@ -1138,6 +1145,7 @@ def main() -> None:
                 "dispatched_slots": packer.dispatched_slots,
                 "batch_occupancy_baseline": baseline["packing_occupancy"],
                 "batch_videos_per_sec": baseline["videos_per_sec"],
+                "wal": svc.stats().get("wal"),
                 "code_rev": code_rev,
             }
             details["service_steady_state"] = entry

@@ -84,6 +84,7 @@ _FAST_MODULES = {
     "test_spatial",
     "test_vftlint",
     "test_video_decode",
+    "test_wal",
 }
 
 

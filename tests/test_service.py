@@ -351,6 +351,26 @@ def test_duplicate_inflight_path_rejected():
         q.submit(_req("b", ["/x"]))
 
 
+def test_held_jobs_invisible_until_release_but_reserved():
+    """The WAL ack barrier's scheduler half: hold=True assigns seqs and
+    reserves quota/duplicate slots, but the serving loop cannot pop the
+    jobs until release() — otherwise a pop-dispatch-crash could beat the
+    admission record to disk and lose the request."""
+    q = RequestQueue(default_quota=3)
+    jobs = q.submit(_req("a", ["/1", "/2"]), hold=True)
+    assert [j.seq for j in jobs] == [1, 2]
+    assert q.next_job() is None  # not poppable yet
+    assert q.peek_jobs(4) == []
+    with pytest.raises(RequestRejected, match="already queued"):
+        q.submit(_req("b", ["/1"]))  # reserved against duplicates
+    with pytest.raises(RequestRejected, match="over quota"):
+        q.submit(_req("a", ["/3", "/4"]))  # held jobs count toward quota
+    q.release(jobs)
+    assert q.pending("a") == 2
+    assert [q.next_job().path for _ in range(2)] == ["/1", "/2"]
+    q.submit(_req("a", ["/5", "/6", "/7"]))  # quota reservation released
+
+
 def test_requeue_keeps_admission_order_and_drain_tenant_empties():
     q = RequestQueue()
     q.submit(_req("a", ["/1", "/2"]))
@@ -440,14 +460,20 @@ def test_spool_ingest_accepts_rejects_and_skips_tenants_json(tmp_path,
     watcher = SpoolWatcher(spool, svc)
     assert watcher.scan_once() == 3  # tenants.json untouched
     names = sorted(os.listdir(spool))
-    assert names == ["bad.json.rejected", "empty.json.rejected",
-                     "good.json.accepted", "results", "tenants.json"]
+    assert names == ["admission.wal", "bad.json.rejected",
+                     "empty.json.rejected", "good.json.accepted", "results",
+                     "tenants.json"]
     assert _result(svc, "bad")["state"] == "rejected"
     assert _result(svc, "empty")["state"] == "rejected"
     svc.request_drain()
     assert svc.run() == 0
     assert _result(svc, "good")["state"] == "done"
     assert len(_outputs(tmp_path, "spool")) == 4  # 2 videos × (feat, ts)
+    # spool hygiene: the claimed .accepted file is gone once the result
+    # record published; rejects are kept (their records say why)
+    names = sorted(os.listdir(spool))
+    assert "good.json.accepted" not in names
+    assert "bad.json.rejected" in names
 
 
 def test_socket_api_round_trip(tmp_path, corpus):
@@ -627,3 +653,255 @@ def test_spool_watcher_thread_feeds_a_live_daemon(tmp_path, corpus):
         watcher.stop()
     assert svc._rc == 0
     assert len(_outputs(tmp_path, "live")) == 4
+
+
+# ---- durable serving: WAL, crash recovery, watchdog (docs/serving.md) ------
+
+
+def _wal_records(svc):
+    with open(os.path.join(svc.cfg.spool_dir, "admission.wal")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_submit_is_wal_logged_before_ack_and_resolved_at_publish(tmp_path,
+                                                                 corpus):
+    svc = _service(tmp_path, "waltrail")
+    r = svc.submit({"tenant": "alice", "videos": corpus[:2],
+                    "request_id": "w-1", "deadline_sec": 3600})
+    # ack barrier: by the time submit returned, the admitted record — id,
+    # tenant, paths, model, deadline, admission seqs — is on disk
+    recs = _wal_records(svc)
+    assert [rec["rec"] for rec in recs] == ["admitted"]
+    assert recs[0]["request"] == "w-1" and recs[0]["tenant"] == "alice"
+    assert recs[0]["feature_type"] == "resnet50"
+    assert recs[0]["deadline"] == r.deadline
+    assert recs[0]["videos"] == [os.path.abspath(v) for v in corpus[:2]]
+    assert recs[0]["seqs"] == [1, 2]
+    assert svc.stats()["wal"]["unresolved"] == 1
+    svc.request_drain()
+    assert svc.run() == 0
+    # publication resolved the entry and the all-resolved log compacted
+    assert _wal_records(svc) == []
+
+
+def test_crash_recovery_exactly_once_byte_parity(tmp_path, corpus):
+    """The tentpole acceptance: a daemon that dies mid-corpus loses nothing
+    and duplicates nothing — the restarted daemon replays the WAL entry,
+    dedupes the videos that landed pre-crash, finishes the rest, and the
+    outputs are byte-identical to an uninterrupted run."""
+    ex_ref = ToyPacked(_cfg(tmp_path, "crash_ref"))
+    assert ex_ref.run(corpus) == len(corpus)
+
+    svc = _service(tmp_path, "crash")
+    r = svc.submit({"tenant": "alice", "videos": corpus,
+                    "request_id": "crash-1", "deadline_sec": 3600})
+    # partially serve: land at least one video, then "crash" before the
+    # request completes (close() flushes the log but never resolves a live
+    # entry — exactly the disk state a SIGKILL leaves)
+    for _ in range(500):
+        svc.step()
+        if r.done:
+            break
+    assert r.done and not r.complete
+    pre_crash_done = len(r.done)
+    svc.close()
+    assert not os.path.exists(
+        os.path.join(svc.notify_dir, "crash-1.result.json"))
+
+    svc2 = _service(tmp_path, "crash")
+    entries = svc2._wal.replayable()
+    assert [e["request"] for e in entries] == ["crash-1"]
+    assert svc2.recover() == 1
+    # survivors re-enter with their ORIGINAL admission seqs, and the
+    # scheduler's counter fast-forwarded past them so a fresh submission
+    # can never mint a colliding seq
+    replayed_seqs = {j.seq for j in svc2.queue.peek_jobs(len(corpus))}
+    assert replayed_seqs and replayed_seqs <= set(entries[0]["seqs"])
+    assert svc2.queue._seq >= max(entries[0]["seqs"])
+    svc2.request_drain()
+    assert svc2.run() == 0
+
+    record = _result(svc2, "crash-1")
+    assert record["state"] == "done"
+    assert len(record["done"]) == len(corpus) and record["failed"] == []
+    # exactly once: byte parity with the uninterrupted run, one
+    # done-manifest entry per video, and the recovered request's pre-crash
+    # videos were deduped (not re-extracted)
+    _assert_bytes_equal(_outputs(tmp_path, "crash"),
+                        _outputs(tmp_path, "crash_ref"))
+    manifest = os.path.join(str(tmp_path / "crash"), "resnet50",
+                            ".done_manifest.jsonl")
+    with open(manifest) as f:
+        done_paths = [json.loads(line)["video"] for line in f if line.strip()]
+    assert len(done_paths) == len(set(done_paths)) == len(corpus)
+    assert len(r.done) == pre_crash_done  # the dead request object is dead
+    # the replayed entry resolved at publish; the log compacted back
+    assert _wal_records(svc2) == []
+
+
+def test_recovery_skips_already_published_requests(tmp_path, corpus):
+    """Crash BETWEEN publish and resolve: the submitter already has its
+    result record, so recovery resolves the entry without re-admitting."""
+    svc = _service(tmp_path, "dup")
+    svc.submit({"videos": corpus[:1], "request_id": "dup-1"})
+    svc.request_drain()
+    assert svc.run() == 0
+    wal = os.path.join(svc.cfg.spool_dir, "admission.wal")
+    with open(wal, "a") as f:  # resurrect the entry, as if resolve was lost
+        f.write(json.dumps({"rec": "admitted", "request": "dup-1",
+                            "tenant": "default", "feature_type": "resnet50",
+                            "videos": [os.path.abspath(corpus[0])],
+                            "seqs": [1]}) + "\n")
+    svc2 = _service(tmp_path, "dup")
+    assert svc2._wal.replayable()
+    assert svc2.recover() == 0
+    assert svc2.queue.pending() == 0
+    assert svc2._wal.unresolved_count() == 0
+    svc2.close()
+
+
+def test_no_recover_drops_unresolved_entries(tmp_path, corpus):
+    spool = str(tmp_path / "norec" / "spool")
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, "admission.wal"), "w") as f:
+        f.write(json.dumps({"rec": "admitted", "request": "old-1",
+                            "tenant": "t", "feature_type": "resnet50",
+                            "videos": [os.path.abspath(corpus[0])],
+                            "seqs": [3]}) + "\n")
+    svc = _service(tmp_path, "norec", recover=False)
+    assert svc.recover() == 0
+    assert svc.queue.pending() == 0
+    assert svc._wal.unresolved_count() == 0
+    svc.close()
+
+
+def test_recovery_drops_entries_for_unloaded_models(tmp_path, corpus):
+    spool = str(tmp_path / "unloaded" / "spool")
+    os.makedirs(spool, exist_ok=True)
+    with open(os.path.join(spool, "admission.wal"), "w") as f:
+        f.write(json.dumps({"rec": "admitted", "request": "old-1",
+                            "tenant": "t", "feature_type": "i3d",
+                            "videos": [os.path.abspath(corpus[0])],
+                            "seqs": [1]}) + "\n")
+    svc = _service(tmp_path, "unloaded")
+    assert svc.recover() == 0  # i3d is not loaded by this daemon
+    assert svc.queue.pending() == 0
+    assert svc._wal.unresolved_count() == 0
+    svc.close()
+
+
+def test_failed_publish_keeps_wal_entry_for_recovery(tmp_path, corpus,
+                                                     monkeypatch):
+    """The post-extract/pre-publish seam: a result-record write failure must
+    leave the WAL entry live, and the next daemon re-publishes from the
+    done-manifests without re-running a single video."""
+    monkeypatch.setenv("VFT_FAULTS", "publish:raise:rec-1:1")
+    reset_faults()
+    svc = _service(tmp_path, "pubfail")
+    svc.submit({"videos": corpus[:1], "request_id": "rec-1"})
+    svc.request_drain()
+    assert svc.run() == 0  # the videos landed; only the notification failed
+    assert not os.path.exists(
+        os.path.join(svc.notify_dir, "rec-1.result.json"))
+    assert [rec["request"] for rec in _wal_records(svc)
+            if rec["rec"] == "admitted"] == ["rec-1"]
+
+    svc2 = _service(tmp_path, "pubfail")
+    assert svc2.recover() == 1  # all videos deduped → published immediately
+    record = _result(svc2, "rec-1")
+    assert record["state"] == "done" and len(record["done"]) == 1
+    assert svc2._wal.unresolved_count() == 0
+    svc2.close()
+
+
+def test_degraded_wal_daemon_keeps_serving(tmp_path, corpus, monkeypatch):
+    """ENOSPC in the WAL (injected at the wal_append seam) degrades
+    durability — loudly, via healthz — but admission and extraction keep
+    working; the daemon never crashes."""
+    monkeypatch.setenv("VFT_FAULTS", "wal_append:raise")
+    reset_faults()
+    svc = _service(tmp_path, "degraded")
+    r = svc.submit({"videos": corpus[:1], "request_id": "deg-1"})
+    h = svc.healthz()
+    assert h["wal"]["enabled"] is True and h["wal"]["durable"] is False
+    svc.request_drain()
+    assert svc.run() == 0
+    assert r.state == "done"
+    assert _result(svc, "deg-1")["state"] == "done"
+
+
+def test_wal_disabled_with_none(tmp_path, corpus):
+    svc = _service(tmp_path, "waloff", wal_path="none")
+    assert svc._wal is None
+    r = svc.submit({"videos": corpus[:1]})
+    assert r.wal_logged is False
+    assert svc.healthz()["wal"] == {"enabled": False}
+    assert svc.recover() == 0
+    svc.request_drain()
+    assert svc.run() == 0
+    assert not os.path.exists(os.path.join(svc.cfg.spool_dir,
+                                           "admission.wal"))
+
+
+def test_healthz_threshold_configurable_and_wal_section(tmp_path, corpus):
+    svc = _service(tmp_path, "hz", healthz_stale_sec=0.01)
+    h = svc.healthz()
+    assert h["stale_threshold_sec"] == 0.01
+    assert h["wal"]["durable"] is True and h["wal"]["unresolved"] == 0
+    svc.submit({"videos": corpus[:1], "request_id": "hz-1"})
+    assert svc.healthz()["wal"]["unresolved"] == 1
+    svc._last_step = time.monotonic() - 1.0
+    assert svc.healthz()["stale"] is True
+    svc._last_step = time.monotonic()
+    assert svc.healthz()["stale"] is False
+    svc.request_drain()
+    assert svc.run() == 0
+
+
+def test_watchdog_monitor_flags_stale_loop(tmp_path, corpus):
+    svc = _service(tmp_path, "wdmon", step_watchdog_sec=0.05)
+    svc._last_step = time.monotonic() - 1.0
+    mon = threading.Thread(target=svc._watchdog_loop, daemon=True)
+    mon.start()
+    deadline = time.time() + 5
+    while time.time() < deadline and not svc._stalled.is_set():
+        time.sleep(0.01)
+    assert svc._stalled.is_set()
+    svc._watchdog_stop.set()
+    mon.join(timeout=2)
+    svc.close()
+
+
+def test_watchdog_trip_requeues_inflight_transiently(tmp_path, corpus):
+    """A tripped watchdog turns the stall into a transient batch failure:
+    the in-flight videos requeue through the slot-attribution machinery (no
+    breaker charge, same retry budget) and the request still completes."""
+    svc = _service(tmp_path, "wdreq", step_watchdog_sec=30.0, retries=2)
+    r = svc.submit({"tenant": "alice", "videos": corpus[:1],
+                    "request_id": "wd-1"})
+    svc.step()  # pop + ingest: the video is now in flight
+    assert svc._jobs
+    job = next(iter(svc._jobs.values()))
+    svc._stalled.set()  # as the monitor would on a wedged step
+    svc.step()  # clears the flag, fails the stalled batch transiently —
+    # the victim requeues with its original seq and THIS step pops it again
+    assert not svc._stalled.is_set()
+    assert job.attempts == 1  # one transient attempt burned, not terminal
+    assert not r.failed
+    assert not svc.breaker.tripped("alice")
+    svc.request_drain()
+    assert svc.run() == 0
+    assert r.state == "done"
+    assert _result(svc, "wd-1")["state"] == "done"
+
+
+def test_spool_retain_keeps_accepted_files(tmp_path, corpus):
+    svc = _service(tmp_path, "retain", spool_retain=True)
+    spool = svc.cfg.spool_dir
+    with open(os.path.join(spool, "keep.json"), "w") as f:
+        json.dump({"videos": corpus[:1]}, f)
+    SpoolWatcher(spool, svc).scan_once()
+    svc.request_drain()
+    assert svc.run() == 0
+    assert _result(svc, "keep")["state"] == "done"
+    assert os.path.exists(os.path.join(spool, "keep.json.accepted"))

@@ -1,0 +1,214 @@
+"""Write-ahead admission log unit layer (serve/wal.py): append/resolve
+round-trips, compaction, torn-tail tolerance, the resolve-before-append
+race, ENOSPC degrade (via the VFT_FAULTS harness), and replay bookkeeping —
+no daemon, no device, pure file + thread mechanics."""
+
+import json
+import os
+
+import pytest
+
+from video_features_tpu.reliability import reset_faults
+from video_features_tpu.serve.wal import WAL_NAME, AdmissionLog, wal_path
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+def _admit(log, rid, videos=("/a.mp4",), seqs=None, **kw):
+    return log.append_admitted({
+        "request": rid, "tenant": kw.pop("tenant", "t"),
+        "feature_type": "resnet50", "deadline": kw.pop("deadline", None),
+        "source": "api", "videos": list(videos),
+        "seqs": list(seqs if seqs is not None
+                     else range(1, len(videos) + 1)), **kw,
+    })
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_append_is_durable_before_ack(tmp_path):
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    assert _admit(log, "r1", ["/a.mp4", "/b.mp4"], seqs=[1, 2]) is True
+    # the ack barrier: by the time append_admitted returned, the record is
+    # on disk — no close/flush needed to observe it
+    recs = _lines(log.path)
+    assert len(recs) == 1
+    assert recs[0]["rec"] == "admitted" and recs[0]["request"] == "r1"
+    assert recs[0]["videos"] == ["/a.mp4", "/b.mp4"]
+    assert recs[0]["seqs"] == [1, 2]
+    assert log.unresolved_count() == 1
+    log.close()
+
+
+def test_resolve_and_replay_round_trip(tmp_path):
+    path = str(tmp_path / "spool" / WAL_NAME)
+    log = AdmissionLog(path)
+    _admit(log, "r1", seqs=[1])
+    _admit(log, "r2", ["/b.mp4", "/c.mp4"], seqs=[2, 3], deadline=99.5)
+    log.resolve("r1", "done")
+    log.close()
+
+    # a second process opens the same log: only r2 is replayable, with its
+    # original seqs and deadline intact
+    log2 = AdmissionLog(path)
+    entries = log2.replayable()
+    assert [e["request"] for e in entries] == ["r2"]
+    assert entries[0]["seqs"] == [2, 3]
+    assert entries[0]["deadline"] == 99.5
+    assert log2.max_seq() == 3
+    assert log2.unresolved_count() == 1
+    assert log2.corrupt_lines == 0
+    log2.close()
+
+
+def test_replay_orders_by_admission_seq(tmp_path):
+    path = str(tmp_path / WAL_NAME)
+    log = AdmissionLog(path)
+    _admit(log, "late", seqs=[7])
+    _admit(log, "early", seqs=[2])
+    log.close()
+    log2 = AdmissionLog(path)
+    assert [e["request"] for e in log2.replayable()] == ["early", "late"]
+    log2.close()
+
+
+def test_compaction_rewrites_empty_when_all_resolved(tmp_path):
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    _admit(log, "r1", seqs=[1])
+    _admit(log, "r2", seqs=[2])
+    log.resolve("r1")
+    log.resolve("r2", "failed")
+    log.close()
+    assert log.compactions == 1
+    assert _lines(log.path) == []  # compacted back to empty, file kept
+    log2 = AdmissionLog(log.path)
+    assert log2.replayable() == []
+    log2.close()
+
+
+def test_torn_tail_line_tolerated_not_fatal(tmp_path):
+    path = str(tmp_path / WAL_NAME)
+    log = AdmissionLog(path)
+    _admit(log, "r1", seqs=[1])
+    log.close()
+    # simulate a crash mid-append: a truncated JSON tail
+    with open(path, "a") as f:
+        f.write('{"rec": "admitted", "request": "r2", "vid')
+    log2 = AdmissionLog(path)
+    assert log2.corrupt_lines == 1
+    assert [e["request"] for e in log2.replayable()] == ["r1"]
+    # the log keeps appending cleanly after the torn tail
+    assert _admit(log2, "r3", seqs=[5]) is True
+    log2.close()
+
+
+def test_malformed_records_counted_as_corrupt(tmp_path):
+    path = str(tmp_path / WAL_NAME)
+    with open(path, "w") as f:
+        f.write(json.dumps({"rec": "admitted"}) + "\n")  # no request id
+        f.write(json.dumps({"rec": "admitted", "request": "r1",
+                            "videos": "not-a-list"}) + "\n")
+        f.write(json.dumps({"rec": "bogus", "request": "r2"}) + "\n")
+        f.write(json.dumps(["not", "a", "dict"]) + "\n")
+    log = AdmissionLog(path)
+    assert log.replayable() == []
+    assert log.corrupt_lines == 4
+    log.close()
+
+
+def test_resolve_before_append_annihilates(tmp_path):
+    """The daemon thread can publish a request's result before the submit
+    thread's WAL append lands: the early resolve must annihilate the
+    admission (no unresolved entry, nothing stuck for replay)."""
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    log.resolve("fast")  # unknown id: remembered, not an error
+    assert _admit(log, "fast", seqs=[1]) is True
+    assert log.unresolved_count() == 0
+    log.close()
+    log2 = AdmissionLog(log.path)
+    assert log2.replayable() == []
+    log2.close()
+
+
+def test_enospc_degrades_loudly_never_crashes(tmp_path, monkeypatch, capsys):
+    """A write failure (the ENOSPC drill, injected at the wal_append seam)
+    turns the log non-durable: append_admitted returns False but STILL
+    returns (no hang, no crash), healthz carries the flag, and the entry
+    stays tracked in memory."""
+    monkeypatch.setenv("VFT_FAULTS", "wal_append:raise")
+    reset_faults()
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    assert _admit(log, "r1", seqs=[1]) is False
+    assert log.degraded is True
+    health = log.health()
+    assert health["durable"] is False
+    assert "degraded_reason" in health
+    assert log.unresolved_count() == 1  # memory still serves healthz/stats
+    # subsequent appends and resolves keep acking without I/O
+    assert _admit(log, "r2", seqs=[2]) is False
+    log.resolve("r1")
+    assert log.unresolved_count() == 1
+    log.close()
+    assert "WAL DEGRADED" in capsys.readouterr().err
+
+
+def test_degraded_log_reports_in_stats(tmp_path, monkeypatch):
+    monkeypatch.setenv("VFT_FAULTS", "wal_append:raise")
+    reset_faults()
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    _admit(log, "r1", seqs=[1])
+    stats = log.stats()
+    assert stats["enabled"] is True and stats["durable"] is False
+    assert stats["appended"] == 0
+    assert stats["unresolved"] == 1
+    log.close()
+
+
+def test_unwritable_directory_degrades_at_open(tmp_path, capsys):
+    target = tmp_path / "blocked"
+    target.write_text("a file where the log wants a directory parent")
+    # path's parent is a FILE: open() fails, the log degrades instead of
+    # raising out of the daemon's constructor
+    log = AdmissionLog(str(target / WAL_NAME))
+    assert _admit(log, "r1", seqs=[1]) is False
+    assert log.degraded is True
+    log.close()
+
+
+def test_fsync_batching_still_acks_every_record(tmp_path):
+    log = AdmissionLog(str(tmp_path / WAL_NAME), fsync_sec=30.0)
+    for i in range(5):
+        assert _admit(log, f"r{i}", seqs=[i + 1]) is True
+    # every record is WRITTEN at ack time even when the fsync is batched
+    assert len(_lines(log.path)) == 5
+    assert log.appended == 5
+    log.close()
+
+
+def test_wal_path_helper(tmp_path):
+    assert wal_path(str(tmp_path)) == os.path.join(str(tmp_path), WAL_NAME)
+
+
+def test_resolve_rejects_unknown_state(tmp_path):
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    with pytest.raises(ValueError):
+        log.resolve("r1", "exploded")
+    log.close()
+
+
+def test_close_is_idempotent_and_keeps_unresolved(tmp_path):
+    log = AdmissionLog(str(tmp_path / WAL_NAME))
+    _admit(log, "r1", seqs=[1])
+    log.close()
+    log.close()
+    # unresolved entries survive close — they are the recovery surface
+    assert [r["request"] for r in _lines(log.path)] == ["r1"]

@@ -59,6 +59,7 @@ LOCK_CLASSES = {"Lock", "RLock", "Condition"}
 LOCK_NAMES: Dict[str, str] = {
     "video_features_tpu/serve/daemon.py:ExtractionService._lock": "service",
     "video_features_tpu/serve/scheduler.py:RequestQueue._lock": "queue",
+    "video_features_tpu/serve/wal.py:AdmissionLog._lock": "wal",
     "video_features_tpu/obs/metrics.py:MetricsRegistry._lock": "registry",
     "video_features_tpu/obs/journal.py:SpanJournal._lock": "journal",
     "video_features_tpu/utils/metrics.py:StageClock._lock": "clock",
@@ -605,6 +606,8 @@ class LockOrderWatch:
     def instrument_service(self, service) -> "LockOrderWatch":
         service._lock = self.wrap(service._lock, "service")
         service.queue._lock = self.wrap(service.queue._lock, "queue")
+        if getattr(service, "_wal", None) is not None:
+            service._wal._lock = self.wrap(service._wal._lock, "wal")
         service.metrics._lock = self.wrap(service.metrics._lock, "registry")
         clock = service.ex.clock
         if clock is not None:

@@ -36,6 +36,7 @@ ALLOWED: Dict[str, int] = {
     "video_features_tpu/run.py": 1,                # best-effort JAX_PLATFORMS shim
     "video_features_tpu/serve/daemon.py": 7,       # per-video isolation point (serving loop) + lazy model-construction arm + cache-hit write arm + best-effort rejection/result records (the daemon must outlive a full notify disk) + profile start/stop arms (an on-demand jax.profiler session failing must report over the socket, not kill the API thread)
     "video_features_tpu/serve/ingest.py": 1,       # one bad socket client must not kill the API thread
+    "video_features_tpu/serve/wal.py": 1,          # writer-thread wrapper: a dead writer would hang every submitter blocked on its ack event — degrade loudly and keep acking
 }
 
 MARKER = "fault-barrier:"

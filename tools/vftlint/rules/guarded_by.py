@@ -47,6 +47,13 @@ GUARDED_BY: Dict[str, Dict[str, str]] = {
         "self._as_snapshot": "service",
         "self._publishing": "service",
     },
+    "video_features_tpu/serve/wal.py": {
+        "self._unresolved": "wal",
+        "self._early_resolved": "wal",
+        "self._degraded": "wal",
+        "self._degraded_reason": "wal",
+        "self._closed": "wal",
+    },
     "video_features_tpu/serve/scheduler.py": {
         "self._tenants": "queue",
         "self._queued_paths": "queue",

@@ -42,6 +42,10 @@ from .. import locks as locks_mod
 LOCK_ORDER: List[str] = [
     "service",    # serve/daemon.py ExtractionService._lock (RLock)
     "queue",      # serve/scheduler.py RequestQueue._lock
+    "wal",        # serve/wal.py AdmissionLog._lock (unresolved map + degrade
+                  # flag; a leaf in practice — WAL I/O runs off-lock on the
+                  # writer thread — positioned under queue because submit
+                  # appends after queue.submit returns)
     "registry",   # obs/metrics.py MetricsRegistry._lock
     "journal",    # obs/journal.py SpanJournal._lock (producer counters)
     "clock",      # utils/metrics.py StageClock._lock

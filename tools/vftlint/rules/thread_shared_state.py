@@ -70,6 +70,16 @@ THREAD_MODULES: Dict[str, str] = {
     # JSONL (the AsyncOutputWriter discipline applied to telemetry);
     # producers only queue-put, the writer only advances its own counters
     "video_features_tpu/obs/journal.py": "telemetry journal writer",
+    # WAL writer: one single-writer thread owns the admission log file;
+    # producers queue-put and block on per-record ack Events, shared flags
+    # (_unresolved/_degraded) live under the 'wal' lock (GUARDED_BY)
+    "video_features_tpu/serve/wal.py":
+        "write-ahead admission log writer (single-writer queue; ack via "
+        "per-record Events)",
+    # hung-step watchdog monitor: communicates with the daemon thread via
+    # threading.Events only (_stalled/_watchdog_stop) — no shared stores
+    "video_features_tpu/serve/daemon.py":
+        "hung-step watchdog monitor (Events only)",
 }
 
 # declared cross-thread stores: module -> {canonical site: discipline}

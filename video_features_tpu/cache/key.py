@@ -104,6 +104,13 @@ EXECUTION_FIELDS = (
                                # (feature_type et al. above), so co-resident
                                # serving shares entries with single-model
                                # runs — pinned by tests/test_multimodel.py
+    "wal_path",                # admission durability, not numerics
+    "wal_fsync_sec",           # WAL fsync batching window
+    "recover",                 # startup replay policy; replayed extraction
+                               # is the same extraction
+    "healthz_stale_sec",       # observability threshold
+    "spool_retain",            # spool-file retention
+    "step_watchdog_sec",       # stall policy; victims requeue, same bytes
 )
 
 # checkpoint names each feature type resolves (weights/store.py callers)

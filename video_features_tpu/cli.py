@@ -258,6 +258,43 @@ def build_parser() -> argparse.ArgumentParser:
                              "subtree, manifests, reference stack/step "
                              "defaults, and cache fingerprint "
                              "(docs/serving.md)")
+    # Serving durability (serve/wal.py, docs/serving.md "Crash recovery")
+    parser.add_argument("--wal_path", default=None,
+                        help="--serve: write-ahead admission log — every "
+                             "accepted request is on disk before its submit "
+                             "is acknowledged, and a crashed daemon replays "
+                             "unresolved entries at the next start (default: "
+                             "<spool_dir>/admission.wal; 'none' disables "
+                             "durable admission)")
+    parser.add_argument("--wal_fsync_sec", type=float, default=0.0,
+                        help="--serve: WAL group-commit window — admissions "
+                             "within this many seconds share one batched "
+                             "fsync (default 0: fsync every record before "
+                             "acknowledging; ~0.05 recommended under high "
+                             "submit rates)")
+    parser.add_argument("--no_recover", dest="recover", action="store_false",
+                        default=True,
+                        help="--serve: do NOT replay unresolved WAL "
+                             "admissions at startup — they are resolved "
+                             "failed and dropped (default: replay, deduped "
+                             "against published results and done-manifests, "
+                             "with original admission seqs and deadlines)")
+    parser.add_argument("--healthz_stale_sec", type=float, default=10.0,
+                        help="--serve: healthz flags the daemon `stale` once "
+                             "the serving loop has not stepped for this many "
+                             "seconds (wedge, or a legitimately long "
+                             "first-traffic compile)")
+    parser.add_argument("--spool_retain", action="store_true", default=False,
+                        help="--serve: keep claimed <id>.json.accepted spool "
+                             "files after their result record publishes "
+                             "(debugging; default removes them)")
+    parser.add_argument("--step_watchdog_sec", type=float, default=None,
+                        help="--serve: hung-step watchdog — when the serving "
+                             "loop stalls past this many seconds, fail the "
+                             "in-flight videos transiently so they requeue "
+                             "instead of waiting out a wedged device step "
+                             "(set well above the worst expected compile "
+                             "time; default: off)")
     # Feature cache (docs/caching.md)
     parser.add_argument("--cache_dir", default=None,
                         help="content-addressed feature cache: "

@@ -266,6 +266,39 @@ class ExtractionConfig:
     # (model, geometry) dispatch (docs/serving.md). None/empty = the
     # single-model daemon.
     serve_models: Optional[Tuple[str, ...]] = None
+    # --- serving durability (serve/wal.py, docs/serving.md "Crash
+    # recovery") ---
+    # Write-ahead admission log path. None = <spool_dir>/admission.wal
+    # (durable admission on by default whenever there is a spool to serve);
+    # "none" disables the WAL entirely — an acknowledged submit then lives
+    # only in process memory and dies with the daemon.
+    wal_path: Optional[str] = None
+    # WAL group-commit window: admissions acknowledged within this many
+    # seconds of the last fsync share one (batched) fsync. 0 (default) =
+    # fsync every appended record before acknowledging — strongest
+    # durability; set ~0.05 under high submit rates (the bench scenario
+    # budget assumes batching on).
+    wal_fsync_sec: float = 0.0
+    # Replay unresolved WAL admissions at startup (--no_recover disables):
+    # each entry is deduped against published result records and per-model
+    # done-manifests, survivors re-enter the scheduler with their original
+    # admission seqs and deadlines. With recovery off, unresolved entries
+    # are resolved failed and dropped (loudly).
+    recover: bool = True
+    # healthz `stale` threshold: the op flags the daemon once the serving
+    # loop has not stepped for this many seconds (wedged, or a legitimately
+    # long first-traffic compile — both mean "not serving right now").
+    healthz_stale_sec: float = 10.0
+    # Keep claimed <id>.json.accepted spool files after their request's
+    # result record publishes (debugging aid); default removes them — the
+    # result record is the durable trace.
+    spool_retain: bool = False
+    # Hung-step watchdog: when the serving loop has not stepped for this
+    # many seconds, fail the in-flight videos transiently so they requeue
+    # (slot attribution charges no tenant's breaker) instead of waiting out
+    # a stalled device step forever. None (default) = off. Set it well above
+    # the worst expected compile time.
+    step_watchdog_sec: Optional[float] = None
     # --- feature cache (docs/caching.md) ---
     # Content-addressed feature cache directory: sha256(container bytes) ×
     # model-config fingerprint → finished feature dict. A hit skips decode
@@ -383,6 +416,14 @@ class ExtractionConfig:
                              "cache directory)")
         if self.spool_poll_sec <= 0:
             raise ValueError("spool_poll_sec must be > 0")
+        if self.wal_fsync_sec < 0:
+            raise ValueError("wal_fsync_sec must be >= 0 (0 = fsync every "
+                             "record)")
+        if self.healthz_stale_sec <= 0:
+            raise ValueError("healthz_stale_sec must be > 0")
+        if self.step_watchdog_sec is not None and self.step_watchdog_sec <= 0:
+            raise ValueError("step_watchdog_sec must be > 0 (omit to disable "
+                             "the watchdog)")
         if self.serve_models:
             if not self.serve:
                 raise ValueError("--serve_models co-loads models into the "

@@ -90,6 +90,7 @@ from typing import (
 import numpy as np
 
 from ..io.output import FeatureAssembly
+from ..reliability.faults import fault_point
 
 
 @dataclass
@@ -440,6 +441,10 @@ class CorpusPacker:
             batch = self._stage_batch([s.clip for s in slots], batch_size)
             row_of = range(len(slots))
         self._scatter_inflight(key)  # resolve this bucket's batch k first
+        # mid-batch chaos seam (docs/reliability.md): a `kill` here dies with
+        # a full batch assembled but never stepped — recovery must replay
+        # every co-packed video of every admitted request
+        fault_point("device", str(key))
         out = spec.step(batch)
         self._rr_last = key[0]  # round-robin seed: the model just served
         if self._staging is not None:

@@ -24,7 +24,15 @@ Spec grammar — rules separated by ``;``, fields by ``:``::
 Declared sites: ``probe`` and ``decode`` (io/video.py), ``ffmpeg``
 (io/ffmpeg.py), ``save`` (io/output.py, between tmp-write and atomic rename),
 ``extract`` (extractors/base.py, wraps the whole per-video attempt),
-``pool_worker`` (parallel/pipeline.py decode-worker body).
+``pool_worker`` (parallel/pipeline.py decode-worker body), ``device``
+(parallel/packer.py, just before a batch's device step dispatches), and the
+serving durability seams (docs/reliability.md "Serving chaos seams"):
+``wal_append`` (serve/wal.py, before an admission record is written — an
+injected OSError here is the ENOSPC degrade drill), ``wal_sync``
+(serve/wal.py, after write/flush but before fsync — a ``kill`` here is the
+post-accept/pre-sync crash), and ``publish`` (serve/daemon.py, before a
+finished request's result record writes — the post-extract/pre-publish
+crash).
 """
 
 from __future__ import annotations
@@ -53,6 +61,9 @@ _SITE_ERRORS = {
     "extract": DeviceError,
     "device": DeviceError,
     "save": OutputError,
+    "wal_append": OutputError,
+    "wal_sync": OutputError,
+    "publish": OutputError,
 }
 
 

@@ -14,8 +14,10 @@ from .daemon import ExtractionService, serve
 from .ingest import SocketAPI, SpoolWatcher, socket_request
 from .request import RequestRejected, ServiceRequest, parse_request
 from .scheduler import RequestQueue
+from .wal import AdmissionLog
 
 __all__ = [
+    "AdmissionLog",
     "DecodeAutoscaler",
     "ExtractionService",
     "RequestQueue",

@@ -83,20 +83,24 @@ from ..io.output import (
 )
 from ..obs import MetricsRegistry
 from ..reliability import (
+    DeviceError,
     TenantBreaker,
     TenantBreakerOpen,
     classify,
     record_failure,
 )
+from ..reliability.faults import fault_point
 from ..utils.metrics import StageClock
 from .autoscale import DecodeAutoscaler
-from .ingest import SPOOL_TENANTS_FILE, SocketAPI, SpoolWatcher
-from .request import RequestRejected, ServiceRequest, parse_request
+from .ingest import SPOOL_TENANTS_FILE, SocketAPI, SpoolWatcher, accepted_path
+from .request import RequestRejected, ServiceRequest, VideoJob, parse_request
 from .scheduler import RequestQueue
+from .wal import WAL_NAME, AdmissionLog
 
-# healthz `stale` threshold: the serving loop stamps every step (idle steps
-# included, ~poll_interval apart), so an age past this means the daemon
-# thread is stuck — wedged, or in a legitimately long first-traffic compile
+# healthz `stale` threshold default (--healthz_stale_sec): the serving loop
+# stamps every step (idle steps included, ~poll_interval apart), so an age
+# past this means the daemon thread is stuck — wedged, or in a legitimately
+# long first-traffic compile
 HEALTH_STALE_SEC = 10.0
 
 
@@ -159,6 +163,16 @@ class ExtractionService:
         self.breaker = TenantBreaker(cfg.tenant_max_failures)
         self.notify_dir = cfg.notify_dir or os.path.join(
             cfg.spool_dir or cfg.output_path, "results")
+        # write-ahead admission log (serve/wal.py): every accepted request
+        # is on disk before its submit is acknowledged, so a crashed daemon's
+        # admitted-but-unfinished requests replay at the next startup
+        # (recover()). Default location: beside the spool it serves.
+        wal_file = cfg.wal_path
+        if wal_file is None and cfg.spool_dir:
+            wal_file = os.path.join(cfg.spool_dir, WAL_NAME)
+        self._wal = (AdmissionLog(wal_file, fsync_sec=cfg.wal_fsync_sec,
+                                  journal=self.journal, metrics=self.metrics)
+                     if wal_file and wal_file.lower() != "none" else None)
         self._autoscaler = (DecodeAutoscaler()
                             if cfg.decode_workers == 0 else None)
         self._as_snapshot = (time.perf_counter(), 0.0, 0, 0)
@@ -177,6 +191,12 @@ class ExtractionService:
         self._coalescer = InflightCoalescer()
         self._draining = threading.Event()
         self._hup = threading.Event()
+        # hung-step watchdog (--step_watchdog_sec): the monitor thread SETS
+        # this when the loop has not stepped past the threshold; the daemon
+        # thread clears it at its next step and fails the stalled batch
+        # transiently (Events only — no unguarded cross-thread stores)
+        self._stalled = threading.Event()
+        self._watchdog_stop = threading.Event()
         self._idle_since: Optional[float] = None
         self._completed_requests = 0
         # healthz liveness: the loop stamps _last_step every step(); the
@@ -252,8 +272,19 @@ class ExtractionService:
                     f"video(s) currently in flight under a live request: "
                     f"{', '.join(sorted(inflight)[:3])}"
                     + ("…" if len(inflight) > 3 else ""))
-            if to_queue:
-                self.queue.submit(request, videos=to_queue)
+            # hold=True when the WAL is on: the jobs get their admission
+            # seqs and reserve quota/duplicate slots, but stay invisible to
+            # the serving loop until the admission record is durable — a
+            # pop-dispatch-crash before the append lands would lose the
+            # request (the spool claim is already consumed by then)
+            jobs = (self.queue.submit(request, videos=to_queue,
+                                      hold=self._wal is not None)
+                    if to_queue else [])
+            # mark BEFORE releasing the lock: _publish_result (daemon
+            # thread) checks this flag to resolve the WAL entry, and an
+            # early resolve must find the flag already set (the log itself
+            # annihilates a resolve-before-append race)
+            request.wal_logged = self._wal is not None and bool(jobs)
             # after queue.submit: a quota rejection there must not leave an
             # admitted event for a request that was never admitted (the
             # per-video queued events landing µs earlier is harmless — the
@@ -266,6 +297,20 @@ class ExtractionService:
             for v in resumed:
                 request.done.append(os.path.abspath(v))
             finished = self._finish_request_locked(request)
+        # the ack barrier (docs/serving.md "Crash recovery"): the admitted
+        # record — id, tenant, paths, model, deadline, admission seqs — is
+        # durably appended BEFORE this submit returns/acknowledges. Disk
+        # I/O, so outside the service lock like every other write.
+        if request.wal_logged:
+            self._wal.append_admitted({
+                "request": request.request_id, "tenant": request.tenant,
+                "feature_type": ft, "deadline": request.deadline,
+                "source": source, "videos": [j.path for j in jobs],
+                "seqs": [j.seq for j in jobs], "wall": time.time(),
+            })
+            # record durable (or the log degraded loudly): NOW the jobs may
+            # feed the serving loop
+            self.queue.release(jobs)
         # result record + prints are blocking work: outside the lock
         print(f"[serve] accepted {request.request_id} "
               f"(tenant={request.tenant}, {len(to_queue)} queued"
@@ -288,6 +333,94 @@ class ExtractionService:
             with self._lock:
                 done = self._done_sets.setdefault(feature_type, loaded)
         return done
+
+    def recover(self) -> int:
+        """Replay a crashed predecessor's unresolved WAL admissions
+        (``--recover``, serve/wal.py; docs/serving.md "Crash recovery").
+
+        Runs at startup BEFORE the ingest transports: each unresolved entry
+        is deduped against its already-published result record and the
+        per-model done-manifests (``--resume`` semantics — recovery always
+        dedupes, whatever ``--resume`` says: exactly-once needs it), then
+        the survivors re-enter the scheduler with their ORIGINAL admission
+        seqs and deadlines through the requeue machinery, so a recovered
+        video never goes to the back of the line behind post-restart
+        traffic. Returns how many requests were re-admitted.
+        """
+        if self._wal is None:
+            return 0
+        entries = self._wal.replayable()
+        if not entries:
+            return 0
+        if not self.cfg.recover:
+            print(f"[serve] --recover off: dropping {len(entries)} "
+                  "unresolved WAL admission(s) from a previous daemon",
+                  file=sys.stderr)
+            for rec in entries:
+                self._wal.resolve(rec["request"], "failed")
+            return 0
+        self._emit("recovery_started", entries=len(entries),
+                   corrupt=self._wal.corrupt_lines or None)
+        print(f"[serve] recovery: {len(entries)} unresolved admission(s) "
+              f"in {self._wal.path}"
+              + (f" ({self._wal.corrupt_lines} torn/corrupt line(s) "
+                 "tolerated)" if self._wal.corrupt_lines else ""))
+        # new admissions must never collide with a replayed seq (the tenant
+        # heaps tiebreak on seq), and replays should keep their priority
+        self.queue.advance_seq(self._wal.max_seq())
+        replayed = 0
+        for rec in entries:
+            rid = rec["request"]
+            ft = rec.get("feature_type") or self.cfg.feature_type
+            if os.path.exists(request_result_path(self.notify_dir, rid)):
+                # the crash hit between publish and resolve: the submitter
+                # already has its answer
+                self._wal.resolve(rid, "done")
+                self._emit("recovery_skipped_duplicate", request=rid,
+                           reason="result record exists")
+                print(f"[serve] recovery: {rid} already published; skipped")
+                continue
+            if ft not in self.models:
+                print(f"[serve] recovery: {rid} wants model {ft!r} which "
+                      "this daemon no longer loads; dropping the entry",
+                      file=sys.stderr)
+                self._wal.resolve(rid, "failed")
+                continue
+            request = ServiceRequest(
+                rid, rec.get("tenant") or "default",
+                tuple(rec.get("videos") or ()),
+                deadline=rec.get("deadline"),
+                source=rec.get("source") or "recovery", feature_type=ft)
+            request.wal_logged = True
+            done = frozenset(load_done_set(feature_output_dir(
+                self.cfg.output_path, ft)))
+            seqs = rec.get("seqs") or []
+            jobs = []
+            with self._lock:
+                self._requests[rid] = request
+                for i, path in enumerate(request.videos):
+                    if path in done:
+                        request.done.append(path)  # landed pre-crash
+                        continue
+                    seq = seqs[i] if i < len(seqs) else 0
+                    jobs.append(VideoJob(path, request, seq=seq))
+                finished = (self._finish_request_locked(request)
+                            if not jobs else None)
+            if jobs:
+                # original seqs + deadlines, through the same requeue path
+                # a transient retry takes (video_requeued journal events)
+                self.queue.requeue_all(jobs)
+            replayed += 1
+            self.metrics.inc("recovery_replayed_total")
+            self._emit("recovery_replayed", request=rid,
+                       tenant=request.tenant, model=ft, videos=len(jobs),
+                       resumed=len(request.done))
+            print(f"[serve] recovery: re-admitted {rid} "
+                  f"({len(jobs)} video(s) to run, {len(request.done)} "
+                  "already done)")
+            # every video already landed: publish now (resolves the entry)
+            self._publish_result(finished)
+        return replayed
 
     def reject(self, request_id: str, reason: str, source: str = "api",
                payload=None) -> None:
@@ -315,6 +448,14 @@ class ExtractionService:
     def step(self) -> bool:
         """One scheduling step; True when it did video work."""
         self._last_step = time.monotonic()  # healthz liveness stamp
+        if self._stalled.is_set():
+            # the watchdog flagged a stall while the previous step was
+            # wedged (hung device dispatch, stuck decode): now that the
+            # loop is stepping again, fail the stalled batch transiently —
+            # its victims requeue through the same slot-attribution path
+            # as any co-packed batch failure
+            self._stalled.clear()
+            self._requeue_stalled()
         if self._hup.is_set():
             self._hup.clear()
             self.reload()
@@ -424,6 +565,9 @@ class ExtractionService:
 
     def run(self) -> int:
         """Serve until drained; returns 0 (no terminal failures) or 1."""
+        if self.cfg.step_watchdog_sec:
+            threading.Thread(target=self._watchdog_loop, daemon=True,
+                             name="step-watchdog").start()
         try:
             while True:
                 did = self.step()
@@ -465,6 +609,9 @@ class ExtractionService:
         if self._closed:
             return
         self._closed = True
+        self._watchdog_stop.set()
+        if self._wal is not None:
+            self._wal.close()
         self.sessions.close()
         self.ex.clock = None
 
@@ -679,11 +826,26 @@ class ExtractionService:
         if finished is None:
             return
         request, record = finished
+        published = False
         try:
+            # post-extract / pre-publish chaos seam: a kill here leaves the
+            # WAL entry unresolved, so the restarted daemon replays the
+            # request, dedupes its done videos, and re-publishes the record
+            fault_point("publish", request.request_id)
             write_request_result(self.notify_dir, request.request_id, record)
+            published = True
         except Exception as e:  # noqa: BLE001 — fault-barrier: the notification is advisory; outputs + manifests already landed
             print(f"[serve] could not write result for "
                   f"{request.request_id}: {e}", file=sys.stderr)
+        if published:
+            # resolve only after the record landed: a failed publish keeps
+            # the WAL entry live, and recovery re-publishes from the
+            # done-manifests instead of losing the notification
+            if self._wal is not None and request.wal_logged:
+                self._wal.resolve(
+                    request.request_id,
+                    "done" if record.get("state") == "done" else "failed")
+            self._cleanup_spool(request)
         with self._lock:
             self._publishing.pop(request.request_id, None)
         self._emit("request_done", request=request.request_id,
@@ -693,6 +855,63 @@ class ExtractionService:
         print(f"[serve] request {request.request_id} {record['state']}: "
               f"{len(request.done)} done, {len(request.failed)} failed")
         self._autoscale_tick()
+
+    def _cleanup_spool(self, request: ServiceRequest) -> None:
+        """Spool hygiene: drop the claimed ``.accepted`` request file once
+        its result record is published (and the WAL entry resolved) — the
+        result record is the durable trace from here on. ``--spool_retain``
+        keeps the files for debugging."""
+        if (request.source != "spool" or not self.cfg.spool_dir
+                or self.cfg.spool_retain):
+            return
+        try:
+            os.remove(accepted_path(self.cfg.spool_dir, request.request_id))
+        except OSError:
+            pass  # already gone, or submitted pre-upgrade under a raw name
+
+    # --- hung-step watchdog (--step_watchdog_sec) ---------------------------
+
+    def _watchdog_loop(self) -> None:
+        """Monitor thread: flag the daemon when the serving loop has not
+        stepped past the threshold. Communication is Events only (SETS
+        ``_stalled``; the daemon thread clears it and requeues) — the
+        monitor never touches request state, so a false positive during a
+        legitimately long first-traffic compile costs one transient requeue
+        of the in-flight batch, not correctness."""
+        thresh = self.cfg.step_watchdog_sec
+        poll = min(max(thresh / 4.0, 0.05), 1.0)
+        while not self._watchdog_stop.wait(poll):
+            age = time.monotonic() - self._last_step
+            if age > thresh and not self._stalled.is_set():
+                self._stalled.set()
+                self._emit("watchdog_stale", age_sec=round(age, 3),
+                           threshold_sec=thresh)
+                self.metrics.inc("watchdog_trips_total")
+                print(f"[serve] watchdog: no step for {age:.1f}s "
+                      f"(threshold {thresh}s); in-flight videos will fail "
+                      "transiently and requeue once the loop resumes",
+                      file=sys.stderr)
+
+    def _requeue_stalled(self) -> None:
+        """The watchdog tripped while the previous step was wedged: turn the
+        stall into a transient batch failure. Every in-flight video fails
+        through the session's slot-attribution path (the same machinery a
+        poisoned co-packed batch uses), so victims requeue with their retry
+        budgets and breakers charge nobody for a device stall."""
+        with self._lock:
+            victims = [(path, job.feature_type or self.cfg.feature_type)
+                       for path, job in self._jobs.items()]
+        if not victims:
+            return
+        print(f"[serve] watchdog: failing {len(victims)} stalled in-flight "
+              f"video(s) transiently for requeue", file=sys.stderr)
+        for path, model in victims:
+            self.sessions.release_decode(path)
+            self.session.fail(path, model, DeviceError(
+                f"{path}: device step stalled past "
+                f"--step_watchdog_sec={self.cfg.step_watchdog_sec}; "
+                "attempt abandoned"))
+        self.session.emit_completed(reap_limit=0)
 
     def _autoscale_tick(self) -> None:
         """Between requests: act on the interval's decode-starvation signal.
@@ -839,6 +1058,10 @@ class ExtractionService:
                                waiting=self._coalescer.waiting())
                           if self.ex._cache is not None
                           else {"enabled": False}),
+                # admission durability (serve/wal.py): additive section, no
+                # schema bump — durable flag, unresolved depth, compactions
+                "wal": (self._wal.stats() if self._wal is not None
+                        else {"enabled": False}),
                 "decode_workers": pool.workers if pool is not None else 0,
                 "tenants": self.queue.stats(),
                 "breaker_open": list(self.breaker.open_tenants()),
@@ -861,9 +1084,12 @@ class ExtractionService:
         service lock — a wedged daemon thread (or one stalled in a long
         first-traffic compile) still answers, and ``last_step_age_sec`` is
         how an operator tells the two apart. ``stale`` trips once the loop
-        has not stepped for :data:`HEALTH_STALE_SEC`; a legitimate cause
+        has not stepped for ``--healthz_stale_sec``; a legitimate cause
         (a 60 s flow compile) looks identical to a wedge by design — both
-        mean "the daemon is not serving right now"."""
+        mean "the daemon is not serving right now". The ``wal`` section is
+        the durability signal: ``durable: false`` means admissions are
+        being acknowledged WITHOUT a landed WAL record (ENOSPC degrade) and
+        a crash would lose them — page on it."""
         now = time.monotonic()
         age = now - self._last_step
         return {
@@ -871,9 +1097,12 @@ class ExtractionService:
             "schema": 1,
             "uptime_sec": round(now - self._started, 3),
             "last_step_age_sec": round(age, 3),
-            "stale": age > HEALTH_STALE_SEC,
+            "stale": age > self.cfg.healthz_stale_sec,
+            "stale_threshold_sec": self.cfg.healthz_stale_sec,
             "draining": self._draining.is_set(),
             "profiling": self._profiling,
+            "wal": (self._wal.health() if self._wal is not None
+                    else {"enabled": False}),
         }
 
     def _profile_op(self, action: str, trace_dir: Optional[str]) -> dict:
@@ -1002,6 +1231,10 @@ def serve(cfg) -> int:
         signal.signal(signal.SIGTERM, on_term)
         signal.signal(signal.SIGINT, on_term)
         signal.signal(signal.SIGHUP, on_hup)
+    # replay a crashed predecessor's unresolved admissions BEFORE the ingest
+    # transports open: recovered jobs hold their original seqs, and no fresh
+    # submission can race the seq fast-forward
+    service.recover()
     watcher.start()
     if api is not None:
         api.start()

@@ -35,6 +35,13 @@ from .request import RequestRejected
 SPOOL_TENANTS_FILE = "tenants.json"
 
 
+def accepted_path(spool_dir: str, request_id: str) -> str:
+    """Where an accepted spool request's claimed file lives — the daemon
+    removes it when the request's result record is published (spool hygiene,
+    unless ``--spool_retain``)."""
+    return os.path.join(spool_dir, request_id + ".json.accepted")
+
+
 class SpoolWatcher:
     """Poll a spool directory for per-tenant request files."""
 

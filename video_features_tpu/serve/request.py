@@ -101,6 +101,10 @@ class ServiceRequest:
         self.done: List[str] = []
         self.failed: List[Dict] = []  # {video, error_class, transient, message}
         self.cache_hits = 0  # done videos served from the feature cache
+        # an `admitted` record for this request is (being) written to the
+        # WAL (serve/wal.py) — publication resolves it; all-resumed requests
+        # never log one (the result record is their durability)
+        self.wal_logged = False
 
     @property
     def complete(self) -> bool:

@@ -45,7 +45,7 @@ DEFAULT_WEIGHT = 1.0
 
 
 class _Tenant:
-    __slots__ = ("name", "weight", "quota", "vtime", "heap")
+    __slots__ = ("name", "weight", "quota", "vtime", "heap", "held")
 
     def __init__(self, name: str, weight: float, quota: int):
         self.name = name
@@ -54,6 +54,9 @@ class _Tenant:
         self.vtime = 0.0
         # (deadline or +inf, seq, job): EDF then FIFO within the tenant
         self.heap: List[tuple] = []
+        # jobs admitted with hold=True but not yet release()d: counted
+        # against the quota, invisible to next_job/peek
+        self.held = 0
 
 
 class RequestQueue:
@@ -163,13 +166,21 @@ class RequestQueue:
 
     # --- submission ----------------------------------------------------------
 
-    def submit(self, request: ServiceRequest, videos=None) -> List[VideoJob]:
+    def submit(self, request: ServiceRequest, videos=None,
+               hold: bool = False) -> List[VideoJob]:
         """Admit every video of ``request`` or none; returns the jobs queued.
 
         ``videos``: the subset to actually queue (the daemon strips
         ``--resume``-done paths); defaults to all of the request's videos.
         Raises :class:`RequestRejected` over quota or on a path already
         pending/in flight.
+
+        ``hold``: validate, reserve the paths, and assign admission seqs,
+        but do NOT make the jobs poppable — the daemon lands the WAL
+        admission record first and then :meth:`release`\\ s them
+        (docs/serving.md "Crash recovery": without the hold, the serving
+        loop could pop, dispatch, and crash before the record is durable).
+        Held jobs count against the quota and the duplicate set.
         """
         import os
 
@@ -190,21 +201,48 @@ class RequestQueue:
                     f"video(s) already queued by a live request: "
                     f"{', '.join(sorted(dup)[:3])}"
                     + ("…" if len(dup) > 3 else ""))
-            was_idle = not t.heap
             jobs = []
             for path in paths:
                 self._seq += 1
                 job = VideoJob(path, request, seq=self._seq)
-                heapq.heappush(t.heap, (*job.sort_key(), job))
                 self._queued_paths.add(path)
                 jobs.append(job)
-                self._note_queued(job, "video_queued")
-            self._gauge_depth_locked(t)
-            if was_idle:
-                # waking tenant joins at the scheduler clock: idle time is
-                # not banked credit against active tenants
-                t.vtime = max(t.vtime, self._vclock)
+            if hold:
+                t.held += len(jobs)
+                return jobs
+            self._publish_jobs_locked(t, jobs)
             return jobs
+
+    def release(self, jobs: List[VideoJob]) -> None:
+        """Make ``hold``-admitted jobs poppable (the WAL record landed)."""
+        with self._lock:
+            by_tenant: Dict[str, List[VideoJob]] = {}
+            for job in jobs:
+                by_tenant.setdefault(job.request.tenant, []).append(job)
+            for tenant, batch in by_tenant.items():
+                t = self._tenant_locked(tenant)
+                t.held = max(t.held - len(batch), 0)
+                self._publish_jobs_locked(t, batch)
+
+    def _publish_jobs_locked(self, t: _Tenant, jobs: List[VideoJob]) -> None:
+        was_idle = not t.heap
+        for job in jobs:
+            heapq.heappush(t.heap, (*job.sort_key(), job))
+            self._note_queued(job, "video_queued")
+        self._gauge_depth_locked(t)
+        if was_idle:
+            # waking tenant joins at the scheduler clock: idle time is
+            # not banked credit against active tenants
+            t.vtime = max(t.vtime, self._vclock)
+
+    def advance_seq(self, seq: int) -> None:
+        """Fast-forward the admission counter past ``seq`` (crash recovery,
+        serve/wal.py): replayed jobs re-enter with their ORIGINAL seqs, and
+        a fresh submission must never mint a colliding seq — the tenant
+        heaps tiebreak on it, and two equal (deadline, seq) keys would fall
+        through to comparing bare :class:`VideoJob` objects."""
+        with self._lock:
+            self._seq = max(self._seq, int(seq))
 
     def requeue(self, job: VideoJob) -> None:
         """Re-admit a transiently-failed video (retry budget handled by the
@@ -279,7 +317,7 @@ class RequestQueue:
 
     @staticmethod
     def _pending_locked(t: _Tenant) -> int:
-        return len(t.heap)
+        return len(t.heap) + t.held
 
     def pending(self, tenant: Optional[str] = None) -> int:
         with self._lock:

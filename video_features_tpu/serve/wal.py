@@ -1,0 +1,372 @@
+"""Write-ahead admission log: durable accounting for the serving daemon.
+
+A daemon that dies (SIGKILL, OOM, power) must not silently lose the requests
+it accepted: admission state lives only in the in-process
+:class:`.scheduler.RequestQueue`, so every accepted request is first appended
+to this log — one ``admitted`` JSON line carrying everything replay needs
+(request id, tenant, video paths, feature type, deadline, and each video's
+admission seq) — and the submit is acknowledged only after the record is on
+disk. A ``done``/``failed`` line resolves the entry when the request's result
+record publishes; once every entry is resolved the log compacts (atomic
+tmp + ``os.replace``, the package-wide write discipline) back to empty.
+
+On the next startup :meth:`ExtractionService.recover` reads the log
+tolerantly (a torn tail line from a crash mid-append is counted, never
+fatal — the same :func:`..reliability.manifest.read_jsonl` contract the
+manifests use), dedupes against published result records and the per-model
+done-manifests, and re-admits the survivors with their original admission
+seqs and deadlines.
+
+Discipline (the ``AsyncOutputWriter``/``SpanJournal`` single-writer idea,
+made synchronous where it matters): producers — ingest threads appending
+admissions, the daemon thread appending resolutions — queue records; ONE
+writer thread owns the file. An admission append blocks its caller on a
+per-record event until the writer has written (and synced) it: that wait is
+the ack barrier, and because the writer drains the queue in batches,
+concurrent admissions share one fsync (group commit). With
+``--wal_fsync_sec > 0`` the fsync itself is batched on a clock — an ack may
+then precede durability by up to that window, trading a bounded power-loss
+window for near-zero steady-state overhead (process death alone loses
+nothing: the bytes are in the page cache).
+
+A full disk NEVER crashes the daemon: any write/sync failure degrades the
+log to non-durable — a loud ``wal_degraded`` journal event, a warning, and a
+``healthz`` flag — and every subsequent append acks immediately without I/O.
+The in-memory unresolved set keeps serving ``healthz``/``stats`` either way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..reliability import OutputError
+from ..reliability.faults import fault_point
+from ..reliability.manifest import read_jsonl
+
+WAL_NAME = "admission.wal"
+
+# writer-queue sentinels (identity-compared)
+_COMPACT = object()
+_CLOSE = object()
+
+
+def wal_path(spool_dir: str) -> str:
+    """The daemon's default WAL location: beside the spool it serves."""
+    return os.path.join(spool_dir, WAL_NAME)
+
+
+class AdmissionLog:
+    """Append-only JSONL write-ahead log with a single writer thread.
+
+    Record shapes (all extra keys ignored on replay — additive forward
+    compat, like every manifest in the package)::
+
+        {"rec": "admitted", "request": "r1", "tenant": "alice",
+         "feature_type": "resnet50", "deadline": null, "source": "spool",
+         "videos": ["/abs/a.mp4"], "seqs": [7], "wall": 1767200000.0}
+        {"rec": "done", "request": "r1"}      # result record published
+        {"rec": "failed", "request": "r1"}    # ditto, terminal-failed state
+
+    ``done``/``failed`` resolve identically; the state is kept for operators
+    reading the raw log. Resolution order is independent of admission order:
+    a resolve for a not-yet-appended id is remembered and annihilates the
+    admission when it arrives (the submit thread can lose a race against a
+    very fast daemon thread).
+    """
+
+    def __init__(self, path: str, fsync_sec: float = 0.0,
+                 journal=None, metrics=None):
+        parent = os.path.dirname(path)
+        if parent:
+            try:
+                os.makedirs(parent, exist_ok=True)
+            except OSError:
+                pass  # the writer's open() fails → degraded, never a crash
+        self.path = path
+        self._fsync_sec = max(fsync_sec, 0.0)
+        self._journal = journal  # ..obs.SpanJournal (emit-only) or None
+        self._metrics = metrics  # ..obs.MetricsRegistry or None
+        # the "wal" lock (vftlint LOCK_NAMES/LOCK_ORDER): guards the
+        # unresolved map + degraded flag. A LEAF scope by construction —
+        # no I/O and no other lock is ever taken under it.
+        self._lock = threading.Lock()
+        self._unresolved: Dict[str, dict] = {}  # request id -> admitted rec
+        self._early_resolved: set = set()  # resolved before their append
+        self._degraded = False
+        self._degraded_reason: Optional[str] = None
+        self._closed = False
+        self.appended = 0  # records the writer landed (writer thread only)
+        self.compactions = 0
+        self._last_sync = time.monotonic()
+        # replay snapshot: the unresolved admissions a PREVIOUS process left
+        # behind, read tolerantly at open (torn tail counted, not fatal)
+        self._replay, self.corrupt_lines = self._load()
+        for rec in self._replay:
+            self._unresolved[rec["request"]] = rec
+        self._q: queue.Queue = queue.Queue()
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="wal-writer")
+        self._thread.start()
+
+    # --- replay (startup, caller thread, nothing else running yet) -----------
+
+    def _load(self) -> Tuple[List[dict], int]:
+        records, corrupt = read_jsonl(self.path)
+        admitted: Dict[str, dict] = {}
+        resolved = set()
+        for rec in records:
+            rid = rec.get("request")
+            kind = rec.get("rec")
+            if not isinstance(rid, str) or not rid:
+                corrupt += 1
+                continue
+            if kind == "admitted" and isinstance(rec.get("videos"), list):
+                admitted.setdefault(rid, rec)
+            elif kind in ("done", "failed"):
+                resolved.add(rid)
+            else:
+                corrupt += 1
+        live = [rec for rid, rec in admitted.items() if rid not in resolved]
+        live.sort(key=lambda r: min(r["seqs"]) if r.get("seqs") else 0)
+        return live, corrupt
+
+    def replayable(self) -> List[dict]:
+        """The previous process's unresolved admissions, admission-ordered.
+        Each is resolved (or re-admitted, then resolved on completion) by
+        :meth:`ExtractionService.recover`; this log keeps appending after
+        them, so an entry stays recoverable until it truly resolves."""
+        return list(self._replay)
+
+    def max_seq(self) -> int:
+        """Highest admission seq in the replay snapshot (the scheduler's
+        counter fast-forwards past it so new admissions never collide)."""
+        return max((max(rec["seqs"]) for rec in self._replay
+                    if rec.get("seqs")), default=0)
+
+    # --- producer side (ingest threads + daemon thread) ----------------------
+
+    def append_admitted(self, record: dict) -> bool:
+        """Durably append one admission BEFORE the submit is acknowledged.
+
+        Blocks until the writer thread has written (and, modulo the fsync
+        batching window, synced) the record. Returns False when the log is
+        degraded — the caller acked a non-durable admission, which healthz
+        and the ``wal_degraded`` event already advertise.
+        """
+        rid = record["request"]
+        with self._lock:
+            if self._closed:
+                return False
+            if rid in self._early_resolved:
+                # the daemon resolved this request before our append landed:
+                # nothing left to recover, so nothing to write
+                self._early_resolved.discard(rid)
+                return not self._degraded
+            self._unresolved[rid] = record
+            degraded = self._degraded
+        self._gauge()
+        if degraded:
+            return False
+        landed = threading.Event()
+        self._q.put((dict(record, rec="admitted"), landed))
+        landed.wait()
+        with self._lock:
+            return not self._degraded
+
+    def resolve(self, request_id: str, state: str = "done") -> None:
+        """Mark one admission terminal (its result record published).
+
+        Fire-and-forget: resolution is an optimization (it bounds replay
+        work), not an ack barrier — a crash before the resolve record lands
+        just means one redundant, deduped replay next startup.
+        """
+        if state not in ("done", "failed"):
+            raise ValueError(f"WAL resolve state must be done/failed, "
+                             f"got {state!r}")
+        with self._lock:
+            if self._closed:
+                return
+            known = self._unresolved.pop(request_id, None)
+            if known is None:
+                self._early_resolved.add(request_id)
+                return
+            empty = not self._unresolved
+            degraded = self._degraded
+        self._gauge()
+        if degraded:
+            return
+        self._q.put(({"rec": state, "request": request_id}, None))
+        if empty:
+            self._q.put((_COMPACT, None))
+
+    # --- introspection (any thread; healthz/stats) ---------------------------
+
+    @property
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+    def unresolved_count(self) -> int:
+        with self._lock:
+            return len(self._unresolved)
+
+    def health(self) -> dict:
+        """The healthz payload's ``wal`` section (docs/serving.md)."""
+        with self._lock:
+            degraded = self._degraded
+            reason = self._degraded_reason
+            unresolved = len(self._unresolved)
+        out = {
+            "enabled": True,
+            "durable": not degraded,
+            "unresolved": unresolved,
+            "last_sync_age_sec": round(
+                time.monotonic() - self._last_sync, 3),
+        }
+        if reason:
+            out["degraded_reason"] = reason
+        return out
+
+    def stats(self) -> dict:
+        """The stats op's ``wal`` section (additive; no schema bump)."""
+        return dict(self.health(), path=self.path, appended=self.appended,
+                    compactions=self.compactions,
+                    corrupt_lines=self.corrupt_lines)
+
+    def _gauge(self) -> None:
+        if self._metrics is not None:
+            self._metrics.set_gauge("wal_unresolved", self.unresolved_count())
+
+    # --- lifecycle ------------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush and stop the writer (idempotent). Unresolved entries stay
+        on disk deliberately — they are exactly what the next process's
+        recovery pass must see."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._q.put((_CLOSE, None))
+        self._thread.join(timeout=10.0)
+
+    # --- writer thread --------------------------------------------------------
+
+    def _degrade(self, exc: BaseException) -> None:
+        """ENOSPC (or any write/sync failure) turns the log non-durable —
+        loudly — instead of crashing the daemon or blocking admissions."""
+        with self._lock:
+            if self._degraded:
+                return
+            self._degraded = True
+            self._degraded_reason = str(exc)[:200]
+        print(f"[serve] WAL DEGRADED to non-durable ({self.path}): {exc} — "
+              "admissions continue un-logged; a crash before this clears "
+              "will lose them (healthz carries the flag)", file=sys.stderr)
+        if self._journal is not None:
+            self._journal.emit("wal_degraded", path=self.path,
+                               error=str(exc)[:200])
+        if self._metrics is not None:
+            self._metrics.inc("wal_degraded_total")
+
+    def _compact_file(self, f):
+        """All entries resolved: rewrite the log empty via tmp+replace and
+        return a fresh append handle (``None`` after a failure → degrade)."""
+        tmp = self.path + ".tmp"
+        with self._lock:
+            if self._unresolved:  # raced a new admission: keep appending
+                return f
+        f.close()
+        with open(tmp, "w") as t:
+            t.flush()
+            os.fsync(t.fileno())
+        os.replace(tmp, self.path)
+        self.compactions += 1  # thread-shared-state: written only by the single writer thread; stats readers take a GIL-atomic monotone int load
+        return open(self.path, "a")
+
+    def _drain(self) -> None:
+        try:
+            self._drain_loop()
+        except Exception as e:  # noqa: BLE001 — fault-barrier: a writer-thread death would hang every submitter blocked on its ack event; degrade loudly and keep acking instead
+            self._degrade(e)
+            while True:
+                rec, landed = self._q.get()
+                if landed is not None:
+                    landed.set()
+                if rec is _CLOSE:
+                    break
+
+    def _drain_loop(self) -> None:
+        try:
+            f = open(self.path, "a")
+        except OSError as e:
+            self._degrade(e)
+            f = None
+        last_fsync = time.monotonic()
+        while True:
+            batch = [self._q.get()]
+            while True:
+                try:
+                    batch.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            closing = False
+            wrote = False
+            for rec, landed in batch:
+                if rec is _CLOSE:
+                    closing = True
+                    continue
+                if rec is _COMPACT:
+                    if f is not None:
+                        try:
+                            f = self._compact_file(f)
+                        except OSError as e:
+                            self._degrade(e)
+                            f = None
+                    continue
+                if f is not None:
+                    try:
+                        fault_point("wal_append", rec.get("request", ""))
+                        f.write(json.dumps(rec, default=str) + "\n")
+                        wrote = True
+                        self.appended += 1  # thread-shared-state: written only by the single writer thread; stats readers take a GIL-atomic monotone int load
+                    except (OSError, OutputError) as e:
+                        self._degrade(e)
+                        f = None
+            if f is not None and wrote:
+                try:
+                    f.flush()
+                    # post-accept / pre-WAL-sync chaos seam: a kill here
+                    # proves the ack barrier (the submitter was never told
+                    # yes, so losing the record is allowed; an acked record
+                    # must survive the restart)
+                    fault_point("wal_sync", "")
+                    now = time.monotonic()
+                    if (self._fsync_sec <= 0.0 or closing
+                            or now - last_fsync >= self._fsync_sec):
+                        os.fsync(f.fileno())
+                        last_fsync = now
+                        self._last_sync = now  # thread-shared-state: written only by the single writer thread; healthz readers take a GIL-atomic monotone float load
+                except (OSError, OutputError) as e:
+                    self._degrade(e)
+                    f = None
+            # ack AFTER the write+sync attempt — degraded appends ack too
+            # (the caller checks the flag), a blocked submitter never hangs
+            for _, landed in batch:
+                if landed is not None:
+                    landed.set()
+            if closing:
+                break
+        if f is not None:
+            try:
+                f.flush()
+                os.fsync(f.fileno())
+                f.close()
+            except OSError:
+                pass
