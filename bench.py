@@ -967,6 +967,79 @@ def main() -> None:
                          "pair slots", ex.batch_size,
                          warm=lambda: warm_flow(ex), record_buckets=True)
 
+    # ---- ragged paged dispatch (--paged_batching, docs/performance.md) --------
+    # The SAME mixed-geometry corpus through the default depth-2 paged
+    # dispatch vs the bucketed loop (--no_paged_batching): pad-waste ratio =
+    # padded rows / dispatched rows. The paged flush tail is bounded by one
+    # partial PAGE (≤ page_rows - 1 rows) instead of one partial batch, so on
+    # a corpus whose slot total is ≡ page_rows (mod batch) the paged waste
+    # lands strictly below the bucketed waste; the observed in-flight ring
+    # depth (≥ 2 under paged dispatch, exactly 1 bucketed) is recorded
+    # alongside. Stale-record protocol unchanged: rides guarded()/
+    # clear_failure like every packed scenario.
+    if not over_budget("paged_mixed_geometry"):
+        with guarded("paged_mixed_geometry"):
+            from video_features_tpu.parallel.pages import build_row_table
+
+            pg_batch = 4 if on_cpu else 64
+            n = 5 if on_cpu else 16
+            # two source geometries; the resnet host path normalizes both
+            # into the one 224² page family. Slot totals: CPU 4+5+4+5+4 = 22
+            # ≡ 2 (mod 4), TPU 16×14 = 224 ≡ 32 (mod 64) — the bucketed
+            # flush pads batch/2 rows, the paged flush pads zero
+            corpus = write_corpus(
+                "paged_corpus",
+                [(((64, 48) if i % 2 else (48, 32)),
+                  (4 + (i % 2)) if on_cpu else 14) for i in range(n)])
+            entry = {"unit": "frame slots", "videos": n, "code_rev": code_rev}
+            for paged_mode, key in ((True, "paged"), (False, "bucketed")):
+                ex = ExtractResNet50(cfg(
+                    "resnet50", batch_size=pg_batch, pack_corpus=True,
+                    on_extraction="save_numpy", paged_batching=paged_mode,
+                    decode_workers=1 if on_cpu else 4))
+                if paged_mode:
+                    # warm the memoized paged program outside the clock
+                    spec = ex.pack_spec()
+                    _force(spec.paged_step(
+                        np.zeros((spec.page_rows, 224, 224, 3), np.uint8),
+                        build_row_table([(0, 0)], spec.page_rows))[0])
+                    entry["page_rows"] = spec.page_rows
+                    entry["pages_in_flight"] = spec.pages_in_flight
+                else:
+                    _force(ex._step(ex.params, ex.runner.put(
+                        np.zeros((pg_batch, 224, 224, 3), np.uint8))))
+                shutil.rmtree(ex.output_dir, ignore_errors=True)
+                t0 = time.perf_counter()
+                ok = ex.run(corpus)
+                wall = time.perf_counter() - t0
+                if ok != n:
+                    raise RuntimeError(f"{key} pass extracted {ok}/{n}")
+                stats = ex._pack_stats
+                entry[key] = {
+                    "videos_per_sec": round(ok / wall, 3),
+                    "wall_sec": round(wall, 3),
+                    "real_slots": stats["real_slots"],
+                    "dispatched_slots": stats["dispatched_slots"],
+                    "pad_waste_ratio": round(
+                        1.0 - stats["real_slots"]
+                        / max(stats["dispatched_slots"], 1), 4),
+                    "batches_in_flight": stats["max_in_flight"],
+                }
+                if paged_mode:
+                    entry[key]["pages_dispatched"] = stats["pages_dispatched"]
+            entry["paged_waste_strictly_below_bucketed"] = bool(
+                entry["paged"]["pad_waste_ratio"]
+                < entry["bucketed"]["pad_waste_ratio"])
+            details["paged_mixed_geometry"] = entry
+            clear_failure("paged_mixed_geometry")
+            flush_details()
+            _log(f"paged_mixed_geometry: paged waste "
+                 f"{entry['paged']['pad_waste_ratio']} at depth "
+                 f"{entry['paged']['batches_in_flight']} vs bucketed "
+                 f"{entry['bucketed']['pad_waste_ratio']} "
+                 f"(strictly below: "
+                 f"{entry['paged_waste_strictly_below_bucketed']})")
+
     if not over_budget("packed_vggish"):
         with guarded("packed_vggish"):
             from scipy.io import wavfile
@@ -1087,8 +1160,11 @@ def main() -> None:
                     rng.integers(0, 256, (batch, 224, 224, 3),
                                  dtype=np.uint8))))
 
-            baseline = bench_packed("service_batch_baseline", ex_b, corpus,
-                                    "frame slots", batch, warm=warm_svc)
+            # svc_baseline, NOT baseline: this scope sees main's headline
+            # baseline float, and rebinding it to this entry dict made the
+            # final print_summary() divide a float by a dict
+            svc_baseline = bench_packed("service_batch_baseline", ex_b, corpus,
+                                        "frame slots", batch, warm=warm_svc)
 
             shutil.rmtree(os.path.join("/tmp/vft_bench", "svc_serve"),
                           ignore_errors=True)  # fresh manifests per sweep
@@ -1143,8 +1219,8 @@ def main() -> None:
                 "packing_occupancy": round(packer.occupancy, 4),
                 "real_slots": packer.real_slots,
                 "dispatched_slots": packer.dispatched_slots,
-                "batch_occupancy_baseline": baseline["packing_occupancy"],
-                "batch_videos_per_sec": baseline["videos_per_sec"],
+                "batch_occupancy_baseline": svc_baseline["packing_occupancy"],
+                "batch_videos_per_sec": svc_baseline["videos_per_sec"],
                 "wal": svc.stats().get("wal"),
                 "code_rev": code_rev,
             }

@@ -1,0 +1,327 @@
+"""Ragged paged batching (parallel/pages.py + the packer's paged dispatch
+mode): page geometry and row-table semantics, the masked paged program, the
+ONE legal buffer donation (the int32 row table through MeshRunner.jit_paged)
+vs the uint8-wire steps declining donation, depth-2 paged-vs-bucketed byte
+parity at matched jit signatures for the real models (resnet50 / r21d_rgb /
+i3d-rgb over a mixed-geometry corpus), >=2 pages in flight observable in the
+--telemetry_dir journal, and slot-level fault attribution for co-hosted
+pages (a poisoned video fails only itself; --retry_failed reprocesses it;
+the corpus-flush partial page stays byte-exact)."""
+# fast-registry: default tier — paged dispatch parity (jit compiles)
+
+import dataclasses
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from test_packer import ToyPacked, _write_video
+
+from video_features_tpu.config import ExtractionConfig
+from video_features_tpu.io.output import load_done_set
+from video_features_tpu.obs.export import load_journal
+from video_features_tpu.parallel.mesh import MeshRunner
+from video_features_tpu.parallel.pages import (
+    PAD_ROW,
+    build_row_table,
+    mask_rows,
+    page_rows_for,
+    paged_program,
+)
+from video_features_tpu.reliability import load_failures, reset_faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv("VFT_FAULTS", raising=False)
+    reset_faults()
+    yield
+    reset_faults()
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _random_weights():
+    mp = pytest.MonkeyPatch()
+    mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    yield
+    mp.undo()
+
+
+def _cfg(tmp_path, sub, **kw):
+    kw.setdefault("retries", 0)
+    kw.setdefault("retry_backoff", 0.01)
+    kw.setdefault("pack_corpus", True)
+    return ExtractionConfig(
+        on_extraction="save_numpy", num_devices=1,
+        output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "t"), **kw)
+
+
+# ---- page geometry + row tables (host side) ---------------------------------
+
+
+def test_page_rows_for_splits_the_batch_budget_by_depth():
+    # depth pages of ceil(batch/depth) rows = one bucketed batch in flight
+    assert page_rows_for(4, 2) == 2
+    assert page_rows_for(5, 2) == 3
+    assert page_rows_for(4, 8) == 1  # never below one row
+    # the mesh multiple rounds the page up, exactly like a bucketed batch
+    assert page_rows_for(6, 2, device_batch=lambda n: -(-n // 4) * 4) == 4
+    with pytest.raises(ValueError):
+        page_rows_for(4, 0)
+
+
+def test_build_row_table_fills_pads_and_reuses_buffers():
+    t = build_row_table([(7, 0), (7, 1), (9, 4)], 5)
+    assert t.dtype == np.int32 and t.shape == (5, 3)
+    np.testing.assert_array_equal(t[:3], [[7, 0, 1], [7, 1, 1], [9, 4, 1]])
+    np.testing.assert_array_equal(t[3:], [PAD_ROW, PAD_ROW])
+    # staging-ring reuse: a dirty `out` buffer is overwritten in place
+    out = np.full((5, 3), 99, np.int32)
+    t2 = build_row_table([(1, 2)], 5, out=out)
+    assert t2 is out
+    np.testing.assert_array_equal(t2[0], [1, 2, 1])
+    np.testing.assert_array_equal(t2[1:], [PAD_ROW] * 4)
+    with pytest.raises(ValueError):
+        build_row_table([(0, 0)] * 6, 5)
+
+
+def test_mask_rows_zeroes_pads_exactly_and_keeps_dtypes():
+    valid = jnp.asarray(np.array([1, 0, 1], np.int32))
+    rows = {"f32": jnp.asarray(np.arange(6, dtype=np.float32).reshape(3, 2)),
+            "i32": jnp.asarray(np.arange(3, dtype=np.int32))}
+    m = mask_rows(rows, valid)
+    # x1.0 on real rows is exact; x0.0 zeroes the pad row; dtypes survive
+    np.testing.assert_array_equal(np.asarray(m["f32"]),
+                                  [[0.0, 1.0], [0.0, 0.0], [4.0, 5.0]])
+    assert m["i32"].dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(m["i32"]), [0, 0, 2])
+
+
+def test_paged_program_masks_by_table_and_passes_it_through():
+    def fwd(params, page):
+        return page.astype(jnp.float32) + params["b"]
+
+    table = jnp.asarray(build_row_table([(3, 0), (3, 1), (5, 0)], 4))
+    page = jnp.asarray(np.arange(8, dtype=np.uint8).reshape(4, 2))
+    out, t_out = paged_program(fwd)({"b": jnp.float32(1.0)}, page, table)
+    ref = np.arange(8, dtype=np.float32).reshape(4, 2) + 1.0
+    ref[3] = 0.0  # the pad row is zeroed on device
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    assert t_out is table  # the identity pass-through donation relies on
+
+
+# ---- buffer donation through the mesh seam ----------------------------------
+
+
+def test_jit_paged_donates_the_table_and_uint8_steps_decline():
+    """The int32 row table is the one in/out-identical buffer on the dispatch
+    path: jit_paged donates it and XLA aliases it in place (the donated
+    device value is deleted). The uint8 page and every plain-jit uint8-wire
+    step keep their inputs alive — they donate nothing (mesh.sharded_apply's
+    default), because no output matches their shape/dtype."""
+    runner = MeshRunner(num_devices=1)
+
+    def fwd(params, page):
+        return page.astype(jnp.float32) * params["w"]
+
+    params = runner.put_replicated({"w": np.ones((1,), np.float32)})
+    paged = runner.jit_paged(paged_program(fwd))
+    page = runner.put(np.arange(12, dtype=np.uint8).reshape(4, 3))
+    table = runner.put(build_row_table([(0, 0), (0, 1), (1, 0)], 4))
+    out, t_out = paged(params, page, table)
+    assert table.is_deleted()        # donated: aliased into t_out
+    assert not page.is_deleted()     # uint8 in, fp32 out: never aliases
+    np.testing.assert_array_equal(np.asarray(t_out),
+                                  build_row_table([(0, 0), (0, 1), (1, 0)], 4))
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.arange(12, dtype=np.float32).reshape(4, 3) * [[1.0]] *
+        np.array([[1.0], [1.0], [1.0], [0.0]], np.float32))
+
+    plain = runner.jit(fwd)
+    page2 = runner.put(np.arange(12, dtype=np.uint8).reshape(4, 3))
+    plain(params, page2)
+    assert not page2.is_deleted()    # non-paged steps decline donation
+
+
+# ---- paged vs bucketed byte parity at matched jit signatures ---------------
+#
+# The acceptance bar: depth-2 paged dispatch (batch budget 2N -> two N-row
+# pages in flight) produces byte-identical outputs to the bucketed loop run
+# at batch_size N — the page and the bucketed batch share ONE jit signature
+# per family, so the numerics are the same compiled program either way.
+
+
+def _load_outputs(root, feature_type):
+    out = {os.path.basename(f): np.load(f)
+           for f in glob.glob(str(root / feature_type / "*.npy"))}
+    assert out
+    return out
+
+
+def _assert_bytes_equal(paged, bucketed):
+    assert set(paged) == set(bucketed)
+    for k in paged:
+        assert paged[k].dtype == bucketed[k].dtype, k
+        assert paged[k].shape == bucketed[k].shape, k
+        assert paged[k].tobytes() == bucketed[k].tobytes(), k
+
+
+def test_resnet50_paged_matches_bucketed_across_mixed_geometry(tmp_path):
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+
+    # two source geometries; the host resize+crop normalizes both to 224^2,
+    # so the whole mixed corpus is ONE page family / one compiled program
+    corpus = [_write_video(tmp_path / "a.mp4", 3),
+              _write_video(tmp_path / "b.mp4", 2, size=(48, 36)),
+              _write_video(tmp_path / "c.mp4", 4)]
+    px = ExtractResNet50(_cfg(tmp_path, "paged", feature_type="resnet50",
+                              batch_size=4, pages_in_flight=2))
+    assert px.run(corpus) == len(corpus)
+    bx = ExtractResNet50(_cfg(tmp_path, "buck", feature_type="resnet50",
+                              batch_size=2, paged_batching=False))
+    assert bx.run(corpus) == len(corpus)
+    _assert_bytes_equal(_load_outputs(tmp_path / "paged", "resnet50"),
+                        _load_outputs(tmp_path / "buck", "resnet50"))
+    # shared jit signature: 2-row pages == the bucketed batch shape, and the
+    # mixed source geometries collapsed into a single family
+    assert len(px._pack_stats["buckets"]) == 1
+    assert px._pack_stats["pages_dispatched"] == 5  # 9 frames over 2-row pages
+    assert px._pack_stats["max_in_flight"] >= 2
+    assert bx._pack_stats["pages_dispatched"] == 0
+    assert bx._pack_stats["max_in_flight"] == 1
+
+
+def test_r21d_paged_matches_bucketed_across_mixed_geometry(tmp_path):
+    from video_features_tpu.extractors.r21d import ExtractR21D
+
+    # native-resolution slots: two decoded geometries = two page families,
+    # each paged under its own compiled program
+    corpus = [_write_video(tmp_path / "a.mp4", 6),
+              _write_video(tmp_path / "b.mp4", 4),
+              _write_video(tmp_path / "c.mp4", 6, size=(48, 32))]
+    kw = dict(feature_type="r21d_rgb", stack_size=2, step_size=2)
+    px = ExtractR21D(_cfg(tmp_path, "paged", clips_per_batch=4,
+                          pages_in_flight=2, **kw))
+    assert px.run(corpus) == len(corpus)
+    bx = ExtractR21D(_cfg(tmp_path, "buck", clips_per_batch=2,
+                          paged_batching=False, **kw))
+    assert bx.run(corpus) == len(corpus)
+    _assert_bytes_equal(_load_outputs(tmp_path / "paged", "r21d_rgb"),
+                        _load_outputs(tmp_path / "buck", "r21d_rgb"))
+    assert len(px._pack_stats["buckets"]) == 2
+    assert px._pack_stats["pages_dispatched"] > 0
+    assert px._pack_stats["max_in_flight"] >= 2
+
+
+def test_i3d_rgb_paged_matches_bucketed(tmp_path):
+    from video_features_tpu.extractors.i3d import ExtractI3D
+
+    # mixed source geometries normalize through the i3d host resize/crop
+    corpus = [_write_video(tmp_path / "a.mp4", 17, size=(64, 48)),
+              _write_video(tmp_path / "b.mp4", 34, size=(80, 64))]
+    kw = dict(feature_type="i3d", streams=("rgb",), stack_size=16,
+              step_size=16, i3d_pre_crop_size=64, i3d_crop_size=32)
+    px = ExtractI3D(_cfg(tmp_path, "paged", clips_per_batch=4,
+                         pages_in_flight=2, **kw))
+    assert px.run(corpus) == len(corpus)
+    bx = ExtractI3D(_cfg(tmp_path, "buck", clips_per_batch=2,
+                         paged_batching=False, **kw))
+    assert bx.run(corpus) == len(corpus)
+    _assert_bytes_equal(_load_outputs(tmp_path / "paged", "i3d"),
+                        _load_outputs(tmp_path / "buck", "i3d"))
+    assert px._pack_stats["pages_dispatched"] > 0
+
+
+# ---- engine-level paged dispatch: toy model --------------------------------
+
+
+class ToyPaged(ToyPacked):
+    """ToyPacked with its pack spec switched to ragged paged dispatch (the
+    per-row toy forward is batch-shape exact, so paged pages must reproduce
+    the per-video loop's bytes whatever the page size)."""
+
+    def _forward(self, params, frames_u8):
+        x = frames_u8.astype(jnp.float32)
+        return jnp.stack([x.mean(axis=(1, 2, 3)), x.max(axis=(1, 2, 3))],
+                         axis=-1)
+
+    def pack_spec(self):
+        spec = super().pack_spec()
+        paged = self._paged_fields(self._forward, self._params, self.BATCH)
+        return dataclasses.replace(spec, **paged) if paged else spec
+
+
+def _toy_corpus(tmp_path, counts=(3, 5, 9, 2)):
+    return [_write_video(tmp_path / f"vid{i}.mp4", n)
+            for i, n in enumerate(counts)]
+
+
+def test_toy_paged_partial_flush_page_matches_per_video_loop(tmp_path):
+    """19 frames over 2-row pages: nine full pages plus the corpus-flush
+    partial page (one real row + one pad row) — byte-identical to the
+    per-video loop, with the pad waste bounded by the single tail page."""
+    corpus = _toy_corpus(tmp_path)
+    ex = ToyPaged(_cfg(tmp_path, "loop", feature_type="resnet50",
+                       pack_corpus=False))
+    assert ex.run(corpus) == len(corpus)
+    ex.cfg = ex.cfg.replace(pack_corpus=True,
+                            output_path=str(tmp_path / "paged"))
+    from video_features_tpu.io.output import feature_output_dir
+
+    ex.output_dir = feature_output_dir(str(tmp_path / "paged"), "resnet50")
+    assert ex.run(corpus) == len(corpus)
+    _assert_bytes_equal(_load_outputs(tmp_path / "paged", "resnet50"),
+                        _load_outputs(tmp_path / "loop", "resnet50"))
+    stats = ex._pack_stats
+    assert stats["real_slots"] == 19
+    assert stats["dispatched_slots"] == 20  # one pad row, in the flush page
+    assert stats["pages_dispatched"] == 10
+    assert stats["max_in_flight"] == 2
+    (bucket,) = stats["buckets"].values()
+    assert bucket["pages_dispatched"] == 10
+    assert bucket["occupancy"] == 0.95
+
+
+def test_toy_paged_journal_shows_two_pages_in_flight(tmp_path):
+    """The depth-2 ring is observable: dispatch events journal paged=True
+    with the per-bucket in-flight depth, and it reaches 2."""
+    ex = ToyPaged(_cfg(tmp_path, "tel", feature_type="resnet50",
+                       telemetry_dir=str(tmp_path / "tel" / "t")))
+    corpus = _toy_corpus(tmp_path)
+    assert ex.run(corpus) == len(corpus)
+    events, corrupt = load_journal(ex._journal.path)
+    assert corrupt == 0
+    dispatches = [e for e in events if e["event"] == "dispatch"]
+    assert dispatches and all(e["paged"] for e in dispatches)
+    assert max(e["inflight"] for e in dispatches) >= 2
+    assert ex._pack_stats["max_in_flight"] >= 2
+
+
+def test_poisoned_video_in_a_co_hosted_page_fails_only_itself(
+        tmp_path, monkeypatch):
+    """Slot-level fault attribution survives paged dispatch: pages co-host
+    rows from several videos, yet a poisoned video fails alone, its page
+    neighbours complete with full outputs, and --retry_failed reprocesses
+    exactly the manifest set."""
+    corpus = _toy_corpus(tmp_path)
+    monkeypatch.setenv("VFT_FAULTS", "extract:raise_permanent:vid1")
+    ex = ToyPaged(_cfg(tmp_path, "pz", feature_type="resnet50"))
+    assert ex.run(corpus) == len(corpus) - 1
+    failures = load_failures(ex.output_dir)
+    assert set(failures) == {os.path.abspath(corpus[1])}
+    assert len(load_done_set(ex.output_dir)) == len(corpus) - 1
+    ok = {os.path.basename(p)
+          for p in glob.glob(str(tmp_path / "pz" / "resnet50" / "*_feat.npy"))}
+    assert ok == {"vid0_feat.npy", "vid2_feat.npy", "vid3_feat.npy"}
+
+    # --retry_failed semantics: reprocess exactly the manifest set
+    monkeypatch.delenv("VFT_FAULTS")
+    reset_faults()
+    failed = sorted(load_failures(ex.output_dir))
+    assert ex.run(failed) == 1
+    assert load_failures(ex.output_dir) == {}
+    assert len(load_done_set(ex.output_dir)) == len(corpus)

@@ -20,10 +20,14 @@ driving the REAL CLI surface as an operator would — no test harness imports:
    Chrome trace parses as JSON with a complete span chain per request;
 4. the daemon co-loads a second model (``--serve_models r21d_rgb``,
    docs/serving.md): a mixed-traffic step submits carol's request with
-   ``"feature_type": "r21d_rgb"`` to the SAME daemon and asserts
-   byte-parity against a single-model r21d batch run, per-model sections
-   in the socket ``stats`` op, and a clean ``rejected`` record for a
-   request naming an unloaded model;
+   ``"feature_type": "r21d_rgb"`` to the SAME daemon — carol's two videos
+   carry DIFFERENT native geometries, so the daemon serves mixed-geometry
+   traffic through the default ragged paged dispatch (docs/performance.md)
+   — and asserts byte-parity against a single-model r21d batch run,
+   per-model sections plus the paged counters (``pages_dispatched``,
+   ``max_in_flight`` ≥ 2, ``page_occupancy``) in the socket ``stats`` op,
+   the ``vft_page_occupancy`` gauge in the ``metrics`` op, and a clean
+   ``rejected`` record for a request naming an unloaded model;
 5. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
    records for every request, complete per-model done-manifests, and
    byte-identical ``.npy`` outputs against the batch runs.
@@ -116,9 +120,12 @@ def main() -> int:
               "bob": [write_video(os.path.join(root, f"b{i}.mp4"), n)
                       for i, n in enumerate((5, 2))]}
     # carol's videos go to the co-loaded r21d_rgb model (>=16 frames: one
-    # full reference stack each)
-    r21d_videos = [write_video(os.path.join(root, f"c{i}.mp4"), n)
-                   for i, n in enumerate((16, 18))]
+    # full reference stack each) with DIFFERENT native geometries — r21d
+    # keys paged bucket families per decoded shape, so this is the
+    # mixed-geometry paged-serving traffic the stats assertions below pin
+    r21d_videos = [write_video(os.path.join(root, "c0.mp4"), 16),
+                   write_video(os.path.join(root, "c1.mp4"), 18,
+                               size=(48, 32))]
 
     print("[smoke] per-tenant batch reference runs")
     for tenant, vids in videos.items():
@@ -246,6 +253,22 @@ def main() -> int:
         print(f"[smoke] per-model stats: "
               + ", ".join(f"{m}: occupancy {s['occupancy']}"
                           for m, s in stats["models"].items()))
+
+        # ragged paged dispatch (docs/performance.md): the default-on paged
+        # mode must have carried the mixed-geometry traffic above — pages
+        # dispatched, the double-buffered ring observed at depth >= 2, and
+        # page_occupancy reported in the stats op + the metrics gauge
+        packing = stats["packing"]
+        assert packing["pages_dispatched"] > 0, packing
+        assert packing["max_in_flight"] >= 2, packing
+        assert packing["page_occupancy"] > 0, packing
+        metrics = sock_op(os.path.join(spool, "control.sock"),
+                          {"op": "metrics"})
+        assert "vft_page_occupancy" in metrics["prometheus"], \
+            metrics["prometheus"][:400]
+        print(f"[smoke] paged dispatch: {packing['pages_dispatched']} pages, "
+              f"max {packing['max_in_flight']} in flight, page occupancy "
+              f"{packing['page_occupancy']}")
 
         print("[smoke] SIGTERM → graceful drain")
         daemon.send_signal(signal.SIGTERM)

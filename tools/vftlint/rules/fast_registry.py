@@ -35,6 +35,7 @@ DEFAULT_TIER: Dict[str, str] = {
     "test_metrics": "stage-clock tests with real sleeps",
     "test_multihost": "loopback two-process jax.distributed init",
     "test_packer_models": "real-model packed parity (jit compiles)",
+    "test_paged": "paged dispatch parity (jit compiles)",
     "test_resnet": "resnet50 forward parity (heavy compile)",
     "test_vggish": "vggish DSP + forward parity",
     "test_weights_store": "checkpoint store roundtrips",

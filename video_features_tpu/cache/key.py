@@ -74,6 +74,9 @@ EXECUTION_FIELDS = (
     "prefetch_depth",          # transfer pipelining
     "decode_workers",          # host decode parallelism
     "pack_flush_age",          # dispatch timing, not numerics
+    "paged_batching",          # dispatch mechanics; page outputs byte-match
+                               # bucketed (pinned by tests/test_paged.py)
+    "pages_in_flight",         # in-flight depth, not numerics
     "raft_corr",               # impl choice, parity pinned (tests/test_raft)
     "pwc_corr",                # impl choice, parity pinned (test_pallas_corr)
     "pwc_warp",                # impl choice, parity pinned (tests/test_pwc)

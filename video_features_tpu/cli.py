@@ -149,6 +149,24 @@ def build_parser() -> argparse.ArgumentParser:
                              "shape buckets (one compiled program each) "
                              "before decode starts; merged buckets carry "
                              "--shape_bucket's border-perturbation caveat")
+    parser.add_argument("--no_paged_batching", dest="paged_batching",
+                        action="store_false", default=True,
+                        help="disable ragged paged dispatch under "
+                             "--pack_corpus: buckets fall back to batch_size "
+                             "padded batches (one in flight) instead of "
+                             "fixed-size pages with an int32 row table and "
+                             "a donated table buffer. Paged dispatch is on "
+                             "by default for the shape-compatible paths "
+                             "(resnet50, r21d, i3d stacks, vggish); collate "
+                             "models (raft/pwc, i3d flow sandwich) and "
+                             "--device_resize resnet always dispatch "
+                             "bucketed — docs/performance.md")
+    parser.add_argument("--pages_in_flight", type=int, default=2,
+                        help="paged dispatch: in-flight pages per bucket "
+                             "(page_rows = ceil(batch budget / depth), so "
+                             "total in-flight rows match one bucketed "
+                             "batch; >= 2 overlaps host refill with device "
+                             "compute)")
     parser.add_argument("--pack_flush_age", type=int, default=8,
                         help="--pack_corpus anti-starvation flush: dispatch a "
                              "bucket's partial queue once this many videos "

@@ -94,6 +94,23 @@ class ExtractionConfig:
     # Trades padding (occupancy) for latency on rare buckets; 0 disables
     # (partial queues then flush only at corpus end, the PR 4 behavior).
     pack_flush_age: int = 8
+    # --pack_corpus ragged paged dispatch (parallel/pages.py,
+    # docs/performance.md): default ON for the shape-compatible RGB/audio
+    # paths (resnet50, r21d, i3d clip stacks, vggish slabs) — buckets ship
+    # fixed (page_rows, ...) pages plus an int32 row table instead of
+    # batch_size padded batches, keep pages_in_flight pages in flight per
+    # bucket, and donate the row table's device buffer (mesh.py jit_paged).
+    # Outputs stay byte-identical to bucketed dispatch (tests/test_paged.py);
+    # pad waste drops to at most one partial page per flush. Models whose
+    # wire format is geometry-variable on device (--device_resize resnet) or
+    # that collate their own windows (raft/pwc, the i3d flow sandwich) opt
+    # out per PackSpec and dispatch bucketed exactly as before.
+    paged_batching: bool = True
+    # Paged in-flight depth per bucket: the host refills page k+1's staging
+    # buffer while the device chews on page k (>= 2 = double-buffered
+    # dispatch; page_rows = ceil(batch budget / depth), so total in-flight
+    # rows stay at one bucketed batch regardless of depth).
+    pages_in_flight: int = 2
     # Flow-net (RAFT/PWC) conv compute + correlation storage dtype, independent
     # of `dtype` (which governs the feature networks): bfloat16 halves flow-net
     # HBM traffic and MXU passes; correlation ACCUMULATION and coordinate math
@@ -366,6 +383,9 @@ class ExtractionConfig:
         if self.pack_flush_age < 0:
             raise ValueError("pack_flush_age must be >= 0 (0 = flush only at "
                              "corpus end)")
+        if self.pages_in_flight < 1:
+            raise ValueError("pages_in_flight must be >= 1 (2 = the "
+                             "double-buffered default)")
         if self.retries < 0:
             raise ValueError("retries must be >= 0")
         if self.retry_backoff < 0:

@@ -214,6 +214,45 @@ class Extractor(abc.ABC):
         frame-sharded flow sandwich (one clip already fills the mesh)."""
         return None
 
+    def _paged_fields(self, forward, params, batch_size: int) -> dict:
+        """PackSpec kwargs switching this model's buckets to ragged paged
+        dispatch (:mod:`..parallel.pages`, ``--paged_batching``).
+
+        ``forward(params, page)`` is the model's pure per-row device step
+        (preprocess + apply, NOT jitted — this helper compiles the paged
+        wrapper once via :meth:`..parallel.mesh.MeshRunner.jit_paged`, which
+        donates the row-table buffer). ``batch_size`` is the model's bucketed
+        batch budget; the page holds ``ceil(batch_size / pages_in_flight)``
+        rows so total in-flight rows match one bucketed batch. Returns ``{}``
+        when ``--no_paged_batching`` globally opts out — callers splat the
+        result into their PackSpec; models that must stay bucketed
+        (geometry-variable wire formats, collate dispatch) simply never call
+        this, which is the per-model opt-out the spec documents."""
+        if not self.cfg.paged_batching:
+            return {}
+        from ..parallel.pages import page_rows_for, paged_program
+
+        depth = self.cfg.pages_in_flight
+        page_rows = page_rows_for(batch_size, depth, self.runner.device_batch)
+        # memoized per (forward, page budget): pack_spec() runs once per
+        # run()/retry pass, and a fresh jax.jit instance would recompile the
+        # whole paged program each time (forwards are bound methods, so key
+        # by the underlying function — stable across pack_spec calls)
+        key = (getattr(forward, "__func__", forward), page_rows, depth)
+        cache = self.__dict__.setdefault("_paged_programs", {})
+        jitted = cache.get(key)
+        if jitted is None:
+            jitted = self.runner.jit_paged(paged_program(forward))
+            cache[key] = jitted
+
+        def paged_step(page, table):
+            # the table's device value is DONATED into the jitted call; the
+            # packer holds the host staging buffers until `out` resolves
+            return jitted(params, self._put(page), self._put(table))
+
+        return {"paged_step": paged_step, "page_rows": page_rows,
+                "pages_in_flight": depth}
+
     # --- decode (frame-stream models route through the prefetcher) ---
 
     def _open_inline(self, video_path: str):
@@ -887,6 +926,10 @@ class Extractor(abc.ABC):
             # host bytes staged per dispatched device batch (the wire-format
             # counter the bench's uint8-vs-float32_wire ratio reads)
             "staged_bytes": packer.staged_bytes,
+            # paged dispatch (parallel/pages.py): page count and the deepest
+            # observed in-flight ring — the bench's batches-in-flight proof
+            "pages_dispatched": packer.pages_dispatched,
+            "max_in_flight": packer.max_in_flight,
         }
         if with_metrics:
             dt = time.perf_counter() - t_run

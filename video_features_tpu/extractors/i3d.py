@@ -156,85 +156,78 @@ class ExtractI3D(Extractor):
 
     # --- jitted stack steps -------------------------------------------------
 
+    def _rgb_forward(self, params, stacks_u8):  # (N, S+1, H, W, 3) uint8
+        # pure per-row stream body — jitted whole by `_rgb_step`, composed
+        # (un-jitted) into the paged program by `pack_spec`
+        model = self.i3d["rgb"]
+        x = i3d_preprocess_rgb(
+            _center_crop_nhwc(stacks_u8[:, :-1], self.crop_size),
+            dtype=self.dtype
+        )  # (N, S, crop, crop, 3)
+        feats = model.apply({"params": params}, x, features=True)
+        if self.cfg.show_pred:
+            _, logits = model.apply({"params": params}, x, features=False)
+            return feats, logits
+        return feats, None
+
     @functools.cached_property
     def _rgb_step(self):
-        model = self.i3d["rgb"]
-        with_pred = self.cfg.show_pred
-        dtype = self.dtype
-        crop = self.crop_size
+        return self.runner.jit(self._rgb_forward)
 
-        def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
-            x = i3d_preprocess_rgb(
-                _center_crop_nhwc(stacks_u8[:, :-1], crop), dtype=dtype
-            )  # (N, S, crop, crop, 3)
-            feats = model.apply({"params": params}, x, features=True)
-            if with_pred:
-                _, logits = model.apply({"params": params}, x, features=False)
-                return feats, logits
-            return feats, None
+    def _flow_forward(self, params, stacks_u8):  # (N, S+1, H, W, 3) uint8
+        # pure per-row stream body (flow net + I3D flow stream) — jitted
+        # whole by `_flow_step`, composed into the paged program by
+        # `pack_spec`
+        model = self.i3d["flow"]
+        flow_dtype = (jnp.bfloat16 if self.cfg.flow_dtype == "bfloat16"
+                      else jnp.float32)
+        n, sp1, h, w, _c = stacks_u8.shape
+        frames = stacks_u8.astype(jnp.float32)
+        # shared-frame flow: each frame is encoded ONCE and the N·S
+        # consecutive pairs are formed from the per-frame features (the
+        # encoder/pyramid is the flow nets' dominant stage; pair-split
+        # batches would encode every interior frame twice). The clip axis
+        # stays leading and mesh-sharded: each device flows its own clips.
+        if self.flow_type == "raft":
+            # replicate-pad to /8 and, like the reference, never unpad: the
+            # 224 center crop below runs on the padded flow
+            ph, pw = (8 - h % 8) % 8, (8 - w % 8) % 8
+            pads = ((0, 0), (0, 0),
+                    (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
+            flow = raft_forward_frames(
+                self.flow_params, jnp.pad(frames, pads, mode="edge"),
+                corr_impl=self.cfg.raft_corr, dtype=flow_dtype,
+                n_devices=self.runner.num_devices)
+        else:
+            total = n * (sp1 - 1)
+            if self.cfg.flow_pair_chunk is not None:
+                chunk = self.cfg.flow_pair_chunk or None  # 0 → never chunk
+            else:
+                # auto: the per-pair decoder working set scales with the
+                # /64 flow grid (PWC's internal geometry, models/pwc.py
+                # _grid64); 64 pairs at 256×384 exceeds HBM while 64 at
+                # 256² fits (BASELINE.md round-3 note)
+                from ..models.pwc import _grid64
 
-        return self.runner.jit(step)
+                h64, w64 = _grid64(h, w)
+                chunk = 16 if total * h64 * w64 > 5_000_000 else None
+            flow = pwc_forward_frames(self.flow_params, frames,
+                                      corr_impl=self.cfg.pwc_corr,
+                                      dtype=flow_dtype,
+                                      pair_chunk=chunk,
+                                      warp_impl=self.cfg.pwc_warp)
+        # flow: (N, S, Hp, Wp, 2)
+        x = i3d_preprocess_flow(_center_crop_nhwc(flow, self.crop_size),
+                                dtype=self.dtype)
+        feats = model.apply({"params": params}, x, features=True)
+        if self.cfg.show_pred:
+            _, logits = model.apply({"params": params}, x, features=False)
+            return feats, logits
+        return feats, None
 
     @functools.cached_property
     def _flow_step(self):
-        model = self.i3d["flow"]
-        flow_type = self.flow_type
-        flow_params = self.flow_params
-        with_pred = self.cfg.show_pred
-        dtype = self.dtype
-        flow_dtype = (jnp.bfloat16 if self.cfg.flow_dtype == "bfloat16"
-                      else jnp.float32)
-        raft_corr = self.cfg.raft_corr
-        pwc_corr = self.cfg.pwc_corr
-        pwc_warp = self.cfg.pwc_warp
-        flow_pair_chunk = self.cfg.flow_pair_chunk
-        crop = self.crop_size
-        n_devices = self.runner.num_devices
-
-        def step(params, stacks_u8):  # (N, S+1, H, W, 3) uint8
-            n, sp1, h, w, _c = stacks_u8.shape
-            frames = stacks_u8.astype(jnp.float32)
-            # shared-frame flow: each frame is encoded ONCE and the N·S
-            # consecutive pairs are formed from the per-frame features (the
-            # encoder/pyramid is the flow nets' dominant stage; pair-split
-            # batches would encode every interior frame twice). The clip axis
-            # stays leading and mesh-sharded: each device flows its own clips.
-            if flow_type == "raft":
-                # replicate-pad to /8 and, like the reference, never unpad: the
-                # 224 center crop below runs on the padded flow
-                ph, pw = (8 - h % 8) % 8, (8 - w % 8) % 8
-                pads = ((0, 0), (0, 0),
-                        (ph // 2, ph - ph // 2), (pw // 2, pw - pw // 2), (0, 0))
-                flow = raft_forward_frames(
-                    flow_params, jnp.pad(frames, pads, mode="edge"),
-                    corr_impl=raft_corr, dtype=flow_dtype,
-                    n_devices=n_devices)
-            else:
-                total = n * (sp1 - 1)
-                if flow_pair_chunk is not None:
-                    chunk = flow_pair_chunk or None  # 0 → never chunk
-                else:
-                    # auto: the per-pair decoder working set scales with the
-                    # /64 flow grid (PWC's internal geometry, models/pwc.py
-                    # _grid64); 64 pairs at 256×384 exceeds HBM while 64 at
-                    # 256² fits (BASELINE.md round-3 note)
-                    from ..models.pwc import _grid64
-
-                    h64, w64 = _grid64(h, w)
-                    chunk = 16 if total * h64 * w64 > 5_000_000 else None
-                flow = pwc_forward_frames(flow_params, frames,
-                                          corr_impl=pwc_corr, dtype=flow_dtype,
-                                          pair_chunk=chunk,
-                                          warp_impl=pwc_warp)
-            # flow: (N, S, Hp, Wp, 2)
-            x = i3d_preprocess_flow(_center_crop_nhwc(flow, crop), dtype=dtype)
-            feats = model.apply({"params": params}, x, features=True)
-            if with_pred:
-                _, logits = model.apply({"params": params}, x, features=False)
-                return feats, logits
-            return feats, None
-
-        return self.runner.jit(step)
+        return self.runner.jit(self._flow_forward)
 
     @functools.cached_property
     def _flow_step_sharded(self):
@@ -355,7 +348,23 @@ class ExtractI3D(Extractor):
 
         return PackSpec(batch_size=self.clips_per_batch,
                         empty_row_shape=(len(streams), 1024),
-                        open_clips=open_clips, step=step, finalize=finalize)
+                        open_clips=open_clips, step=step, finalize=finalize,
+                        **self._paged_fields(self._composite_forward,
+                                             self.i3d_params,
+                                             self.clips_per_batch))
+
+    def _composite_forward(self, params, stacks_u8):
+        # paged composite: every configured stream's un-jitted body over one
+        # page, compiled as ONE program by jit_paged — same (N, n_streams,
+        # 1024) row layout the bucketed step fetches. A method (not a
+        # pack_spec-local closure) so _paged_fields' program cache can key it
+        # across pack_spec() calls.
+        feats = []
+        for s in self.streams:
+            body = self._rgb_forward if s == "rgb" else self._flow_forward
+            f, _logits = body(params[s], stacks_u8)
+            feats.append(f)
+        return jnp.stack(feats, axis=1)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames_iter = self._open_video(video_path)

@@ -65,18 +65,18 @@ class ExtractR21D(Extractor):
         init = lambda r, d: self.model.init(r, d, features=False)  # noqa: E731
         return random_params_like(init, jax.random.PRNGKey(0), dummy)["params"]
 
+    def _forward(self, params, clips_u8):
+        # (N, 16, H, W, 3) uint8 native resolution; pure per-row — the paged
+        # dispatch path wraps this same body (parallel/pages.paged_program)
+        n, t = clips_u8.shape[:2]
+        flat = clips_u8.reshape((n * t,) + clips_u8.shape[2:])
+        x = r21d_preprocess(flat, dtype=self.dtype).reshape((n, t, 112, 112, 3))
+        return self.model.apply(
+            {"params": params}, x, features=True).astype(jnp.float32)
+
     @functools.cached_property
     def _step(self):
-        model = self.model
-        dtype = self.dtype
-
-        def step(params, clips_u8):  # (N, 16, H, W, 3) uint8 native resolution
-            n, t = clips_u8.shape[:2]
-            flat = clips_u8.reshape((n * t,) + clips_u8.shape[2:])
-            x = r21d_preprocess(flat, dtype=dtype).reshape((n, t, 112, 112, 3))
-            return model.apply({"params": params}, x, features=True).astype(jnp.float32)
-
-        return self.runner.jit(step)
+        return self.runner.jit(self._forward)
 
     def pack_spec(self):
         """Corpus-packing seam: slots are ``(stack, H, W, 3)`` native-
@@ -113,7 +113,9 @@ class ExtractR21D(Extractor):
 
         return PackSpec(batch_size=self.clips_per_batch,
                         empty_row_shape=(NUM_FEATURES,),
-                        open_clips=open_clips, step=step, finalize=finalize)
+                        open_clips=open_clips, step=step, finalize=finalize,
+                        **self._paged_fields(self._forward, self.params,
+                                             self.clips_per_batch))
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames, _ts = decode_all(

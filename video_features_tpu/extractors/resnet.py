@@ -102,6 +102,14 @@ class ExtractResNet50(Extractor):
             return None  # debug path prints per-batch top-5 in video order
         from ..parallel.packer import PackSpec
 
+        # Ragged paged dispatch (--paged_batching): the 224² fixed wire
+        # format qualifies; --device_resize opts out per model — its wire
+        # geometry varies per decoded video, so pages cannot co-host
+        # different sources under one compiled program.
+        paged = ({} if self._device_resize
+                 else self._paged_fields(self._forward, self.params,
+                                         self.batch_size))
+
         def open_clips(path):
             meta, frames = self._open_video(path)
             info = {"fps": meta.fps, "timestamps_ms": []}
@@ -126,7 +134,8 @@ class ExtractResNet50(Extractor):
             }
 
         return PackSpec(batch_size=self.batch_size, empty_row_shape=(2048,),
-                        open_clips=open_clips, step=step, finalize=finalize)
+                        open_clips=open_clips, step=step, finalize=finalize,
+                        **paged)
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         meta, frames = self._open_video(video_path)

@@ -64,14 +64,14 @@ class ExtractVGGish(Extractor):
                 "weights", "data", "vggish_pca_params.npz")
         self.postprocessor = Postprocessor(pca_path) if pca_path else None
 
+    def _forward(self, params, examples):
+        # (B, 96, 64) float32; pure per-row — the paged dispatch path wraps
+        # this same body (parallel/pages.paged_program)
+        return self.model.apply({"params": params}, examples)
+
     @functools.cached_property
     def _step(self):
-        model = self.model
-
-        def step(params, examples):  # (B, 96, 64) float32
-            return model.apply({"params": params}, examples)
-
-        return self.runner.jit(step)
+        return self.runner.jit(self._forward)
 
     def pack_spec(self):
         """Corpus-packing seam: every device slot is one fixed ``(96, 64)``
@@ -120,7 +120,9 @@ class ExtractVGGish(Extractor):
 
         return PackSpec(batch_size=self.example_batch,
                         empty_row_shape=(EMBEDDING_SIZE,),
-                        open_clips=open_clips, step=step, finalize=finalize)
+                        open_clips=open_clips, step=step, finalize=finalize,
+                        **self._paged_fields(self._forward, self.params,
+                                             self.example_batch))
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
         wav_path = video_path

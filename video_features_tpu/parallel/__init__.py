@@ -7,6 +7,7 @@ from .mesh import (
     replicate,
     sharded_apply,
 )
+from .pages import build_row_table, mask_rows, page_rows_for, paged_program
 from .pipeline import maybe_initialize_distributed, prefetch_to_device, shard_video_list
 from .spatial import shard_spatial, sharded_conv_stack, sharded_same_conv2d
 
@@ -18,6 +19,10 @@ __all__ = [
     "local_mesh",
     "replicate",
     "sharded_apply",
+    "build_row_table",
+    "mask_rows",
+    "page_rows_for",
+    "paged_program",
     "maybe_initialize_distributed",
     "prefetch_to_device",
     "shard_spatial",

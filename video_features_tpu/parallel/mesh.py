@@ -21,7 +21,7 @@ embarrassingly-parallel split the reference documents via ``gen_file_list.py``.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -57,7 +57,8 @@ def replicate(mesh: Mesh) -> NamedSharding:
 
 def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
                   matmul_precision: Optional[str] = None,
-                  n_replicated_args: int = 0):
+                  n_replicated_args: int = 0,
+                  donate_argnums: Tuple[int, ...] = ()):
     """jit ``fn(params, *batches)`` with params replicated and batches sharded on axis 0.
 
     Each batch argument's leading axis must be divisible by the mesh size — callers
@@ -66,16 +67,18 @@ def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
     are left to XLA (batch-preserving steps keep rows sharded; ``np.asarray``
     gathers them to host).
 
-    Inputs are not donated — not because of the cast per se, but because XLA
-    input-output aliasing needs an output of IDENTICAL shape/dtype/layout to
-    reuse a donated buffer, and with the uint8 wire format no frame-path step
-    has one: every step consumes a uint8 frame buffer (4× smaller than any
-    float activation or output) and emits fp32 (or ``--transfer_dtype``)
-    features/flow, so donation would only emit XLA's "donated buffer could
-    not be aliased" warning per compile. If a step with a genuinely matching
-    output ever lands (e.g. an fp16-in/fp16-out path), thread
-    ``donate_argnums`` through to ``jax.jit`` here — with a test that pins
-    the aliasing actually happening.
+    ``donate_argnums``: XLA input-output aliasing needs an output of
+    IDENTICAL shape/dtype/layout to reuse a donated buffer, and with the
+    uint8 wire format no frame-path *step* has one: every step consumes a
+    uint8 frame buffer (4× smaller than any float activation or output) and
+    emits fp32 (or ``--transfer_dtype``) features/flow, so donating those
+    would only emit XLA's "donated buffer could not be aliased" warning per
+    compile — the non-paged steps therefore donate nothing (default ``()``).
+    The one genuinely matching pair is the paged dispatch mode's int32 row
+    table (same shape/dtype in and out — :meth:`MeshRunner.jit_paged`), the
+    path this seam was documented for; ``tests/test_paged.py`` pins the
+    aliasing actually happening (donated table deleted) AND the uint8 steps
+    still declining donation.
 
     ``matmul_precision``: TPU fp32 convs/matmuls default to bf16 MXU passes;
     ``"highest"`` traces the step under true-fp32 accumulation for the
@@ -95,7 +98,8 @@ def sharded_apply(mesh: Mesh, fn: Callable, n_batch_args: int = 1,
     in_shardings = ((replicate(mesh),)
                     + (batch_sharding(mesh),) * n_batch_args
                     + (replicate(mesh),) * n_replicated_args)
-    return jax.jit(fn, in_shardings=in_shardings)
+    return jax.jit(fn, in_shardings=in_shardings,
+                   donate_argnums=donate_argnums)
 
 
 def enable_compilation_cache(cache_dir: str, min_compile_secs: float = 1.0) -> bool:
@@ -147,6 +151,19 @@ class MeshRunner:
     def jit(self, fn: Callable, n_batch_args: int = 1, n_replicated_args: int = 0):
         return sharded_apply(self.mesh, fn, n_batch_args, self.matmul_precision,
                              n_replicated_args)
+
+    def jit_paged(self, paged_fn: Callable):
+        """jit a paged step ``paged_fn(params, page, table) -> (out, table)``
+        with the int32 row table DONATED (``parallel/pages.py``).
+
+        The table is the one buffer on the dispatch path whose output is
+        identical in shape/dtype/layout to its input (int32 ``(page_rows, 3)``
+        in, passed through unchanged), so XLA aliases it in place — the
+        legal-donation seam :func:`sharded_apply` documents. Pages themselves
+        stay undonated: uint8 in, fp32 features out never alias."""
+        return sharded_apply(self.mesh, paged_fn, n_batch_args=2,
+                             matmul_precision=self.matmul_precision,
+                             donate_argnums=(2,))
 
     def put(self, arr):
         """Transfer a host batch onto the mesh, sharded along axis 0."""

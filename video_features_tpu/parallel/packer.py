@@ -73,7 +73,7 @@ from __future__ import annotations
 import heapq
 import sys
 import time
-from collections import Counter
+from collections import Counter, deque
 from dataclasses import dataclass
 from typing import (
     Any,
@@ -91,6 +91,7 @@ import numpy as np
 
 from ..io.output import FeatureAssembly
 from ..reliability.faults import fault_point
+from .pages import TABLE_COLS, build_row_table
 
 
 @dataclass
@@ -123,6 +124,18 @@ class PackSpec:
     ``prepare(paths)``, when given, runs once before the packed loop starts —
     the flow extractors use it to probe the corpus's container geometries and
     plan the shape buckets.
+
+    ``paged_step(page, table)``, when given (and ``collate`` is not — the
+    flow extractors' window chaining is its own dispatch shape), switches the
+    model's buckets to **ragged paged dispatch** (:mod:`.pages`): batches are
+    fixed ``(page_rows, …)`` pages shipped with an int32 row table, the step
+    returns ``(device_rows, device_table)`` with padding rows masked on
+    device, and each bucket keeps ``pages_in_flight`` pages in flight (the
+    depth-k generalization of the one-batch-in-flight pipeline). NOT setting
+    ``paged_step`` is the per-model opt-out: the extractor's ``pack_spec``
+    omits it (``--paged_batching`` off, ``--show_pred``-adjacent fallbacks,
+    geometry-variable wire formats like ``--device_resize``) and the bucket
+    dispatches exactly as before.
     """
 
     batch_size: int
@@ -134,6 +147,17 @@ class PackSpec:
         Callable[[List[np.ndarray], List[Tuple[int, int]]],
                  Tuple[Any, int, Sequence[int]]]] = None
     prepare: Optional[Callable[[Sequence[str]], None]] = None
+    # ragged paged dispatch (parallel/pages.py): (page, row_table) ->
+    # (device_rows, device_table); None = bucketed dispatch (the opt-out)
+    paged_step: Optional[Callable[[Any, np.ndarray], Tuple[Any, Any]]] = None
+    # rows per page (defaults to batch_size when unset); the extractor sizes
+    # it per family via pages.page_rows_for (batch budget / depth, rounded
+    # up to the mesh multiple)
+    page_rows: Optional[int] = None
+    # in-flight pages per bucket under paged dispatch (≥ 2 = the host
+    # refills page k+1 while the device chews on page k AND k-1's scatter
+    # overlaps); bucketed dispatch always keeps exactly 1
+    pages_in_flight: int = 2
 
 
 class ShapeBuckets:
@@ -202,26 +226,45 @@ class ShapeBuckets:
 
 
 class _Slot:
-    """One occupied device-batch slot: a clip and where its row scatters."""
+    """One occupied device-batch slot: a clip and where its row scatters.
 
-    __slots__ = ("assembly", "idx", "clip")
+    ``vid`` is the attempt's monotonic video id (assigned per ``begin()``) —
+    the row table's first column under paged dispatch; a retry's fresh
+    attempt gets a fresh id, so stale rows of a discarded attempt can never
+    be confused with the retry's in any journaled table."""
 
-    def __init__(self, assembly: FeatureAssembly, idx: int, clip: np.ndarray):
+    __slots__ = ("assembly", "idx", "clip", "vid")
+
+    def __init__(self, assembly: FeatureAssembly, idx: int, clip: np.ndarray,
+                 vid: int = -1):
         self.assembly = assembly
         self.idx = idx
         self.clip = clip
+        self.vid = vid
 
 
 class CorpusPacker:
     """Shape-keyed continuous batching across videos.
 
-    Each shape key keeps one dispatched batch in flight: that key's batch *k*
-    results are fetched (and scattered) only when its batch *k+1* dispatches,
-    at an anti-starvation flush, or at :meth:`flush` — so host decode/stacking
-    of the next batch overlaps device compute of the current one, the packed
-    loop's analogue of the per-video loop's prefetch + ``_throttle``
-    backpressure (at most one unfetched batch per bucket; the bucket planner
-    bounds the bucket count).
+    Each shape key keeps a depth-k ring of dispatched batches in flight
+    (k = ``PackSpec.pages_in_flight`` under paged dispatch, 1 bucketed): a
+    key's batch *k* results are fetched (and scattered) only when the ring is
+    full at its next dispatch, at an anti-starvation flush, or at
+    :meth:`flush` — so host decode/stacking of the next batch overlaps device
+    compute of the in-flight ones, the packed loop's analogue of the
+    per-video loop's prefetch + ``_throttle`` backpressure (bounded unfetched
+    batches per bucket; the bucket planner bounds the bucket count).
+
+    **Paged dispatch** (``PackSpec.paged_step``, :mod:`.pages`): instead of
+    ``batch_size`` padded batches, the bucket ships fixed ``page_rows`` pages
+    plus an int32 row table mapping page rows → (video id, clip idx, valid);
+    the jitted paged program masks by the table and passes it through (the
+    donation-legal pair — ``mesh.py::MeshRunner.jit_paged``). The host's only
+    per-page work is refilling a staging-ring buffer (page + table) and the
+    ``device_put`` inside ``paged_step``; with ``pages_in_flight >= 2`` the
+    scatter of page k overlaps the device chewing on page k+1. Slot-level
+    fault attribution, stale flushes, round-robin fairness, and the stats
+    surface are unchanged — a page is just a smaller, table-carrying batch.
 
     ``flush_age`` > 0 arms the anti-starvation flush: when a key's queue has
     sat non-empty while ``flush_age`` videos finished their streams, its
@@ -263,8 +306,14 @@ class CorpusPacker:
         self._pending: Dict[tuple, List[_Slot]] = {}
         self._open: Dict[str, FeatureAssembly] = {}
         self._finished: List[FeatureAssembly] = []
-        # per shape key: (slots, row_of, device_out) of the unfetched batch
-        self._inflight: Dict[tuple, Tuple[List[_Slot], Sequence[int], Any]] = {}
+        # per shape key: ring of (slots, row_of, fetchable) unfetched
+        # batches, oldest first — depth 1 bucketed, PackSpec.pages_in_flight
+        # under paged dispatch
+        self._inflight: Dict[tuple, deque] = {}
+        # per-attempt monotonic video ids (the row table's first column);
+        # a retry's begin() assigns a fresh id
+        self._video_ids: Dict[str, int] = {}
+        self._vid_seq = 0
         # per shape key: videos-finished count when its queue last became
         # non-empty (anti-starvation age base)
         self._queue_born: Dict[tuple, int] = {}
@@ -272,6 +321,8 @@ class CorpusPacker:
         self.real_slots = 0  # clips dispatched
         self.dispatched_slots = 0  # clips + padding/boundary slots dispatched
         self.staged_bytes = 0  # host bytes staged per dispatched device batch
+        self.pages_dispatched = 0  # paged-mode dispatches (bench/stats)
+        self.max_in_flight = 0  # deepest observed in-flight ring (any key)
         self.video_clips: Dict[str, int] = {}  # per finished video
         # per shape key: {"real_slots", "dispatched_slots", "stale_flushes"}
         self._bucket_stats: Dict[tuple, Dict[str, int]] = {}
@@ -321,12 +372,14 @@ class CorpusPacker:
                            f"packer (have: {sorted(map(str, self._specs))})")
         self.discard(path)
         self._video_model[path] = model
+        self._vid_seq += 1
+        self._video_ids[path] = self._vid_seq
         self._open[path] = FeatureAssembly(path, info)
 
     def add(self, path: str, clip: np.ndarray) -> None:
         """Queue one clip; dispatches device batches when queues fill."""
         asm = self._open[path]
-        slot = _Slot(asm, asm.reserve(), clip)
+        slot = _Slot(asm, asm.reserve(), clip, vid=self._video_ids[path])
         key = (self._video_model[path], clip.shape)
         self._video_keys.setdefault(path, set()).add(key)
         queue = self._pending.setdefault(key, [])
@@ -337,9 +390,23 @@ class CorpusPacker:
         queue.append(slot)
         self._pump()
 
+    @staticmethod
+    def _paged(spec: PackSpec) -> bool:
+        """Paged dispatch is active for a spec that ships a paged step and
+        does not collate (window chaining owns its own dispatch shape)."""
+        return spec.paged_step is not None and spec.collate is None
+
+    def _batch_rows(self, spec: PackSpec) -> int:
+        """Rows per dispatched batch: the page size under paged dispatch,
+        the padded batch size bucketed."""
+        if self._paged(spec):
+            return spec.page_rows or spec.batch_size
+        return spec.batch_size
+
     def _full(self, key: tuple) -> bool:
         queue = self._pending.get(key)
-        return bool(queue) and len(queue) >= self._spec_for(key).batch_size
+        return bool(queue) and len(queue) >= self._batch_rows(
+            self._spec_for(key))
 
     def _pump(self) -> None:
         """Dispatch every full queue, one batch per key per round,
@@ -404,6 +471,7 @@ class CorpusPacker:
         self.video_clips.pop(path, None)
         self._video_keys.pop(path, None)
         self._video_model.pop(path, None)
+        self._video_ids.pop(path, None)
 
     def discard(self, path: str) -> None:
         """Drop every trace of ``path``'s current attempt (failure/retry).
@@ -416,6 +484,7 @@ class CorpusPacker:
         self.video_clips.pop(path, None)
         self._video_keys.pop(path, None)
         self._video_model.pop(path, None)
+        self._video_ids.pop(path, None)
         self._finished = [a for a in self._finished if a.video != path]
         if asm is None:
             return
@@ -426,8 +495,9 @@ class CorpusPacker:
 
     def _dispatch(self, key: tuple) -> None:
         spec = self._spec_for(key)
+        paged = self._paged(spec)
         queue = self._pending[key]
-        batch_size = spec.batch_size
+        batch_size = self._batch_rows(spec)
         candidates = queue[:batch_size]
         if spec.collate is not None:
             batch, n_used, row_of = spec.collate(
@@ -440,39 +510,77 @@ class CorpusPacker:
             del queue[:batch_size]
             batch = self._stage_batch([s.clip for s in slots], batch_size)
             row_of = range(len(slots))
-        self._scatter_inflight(key)  # resolve this bucket's batch k first
+        # depth-k ring: resolve this bucket's OLDEST unfetched batch only
+        # when the ring is full, so scatter of batch k overlaps the device
+        # chewing on k+1..k+depth (bucketed depth is 1 — the original
+        # one-batch-in-flight behavior, scatter-then-step)
+        depth = spec.pages_in_flight if paged else 1
+        ring = self._inflight.setdefault(key, deque())
+        while len(ring) >= max(1, depth):
+            self._scatter_oldest(key)
         # mid-batch chaos seam (docs/reliability.md): a `kill` here dies with
         # a full batch assembled but never stepped — recovery must replay
         # every co-packed video of every admitted request
         fault_point("device", str(key))
-        out = spec.step(batch)
+        if paged:
+            table = self._stage_table(slots, batch_size)
+            out = spec.paged_step(batch, table)
+            fetchable = out[0]  # device rows; the donated table out is dropped
+        else:
+            out = spec.step(batch)
+            fetchable = out
         self._rr_last = key[0]  # round-robin seed: the model just served
         if self._staging is not None:
             # no-op for batches the ring does not own (collate specs commit
             # their own buffers at device_put time, inside step)
             self._staging.commit(batch, out)
+            if paged:
+                self._staging.commit(table, out)
         self.staged_bytes += int(getattr(batch, "nbytes", 0))
-        self._inflight[key] = (slots, row_of, out)
+        ring.append((slots, row_of, fetchable))
+        self.max_in_flight = max(self.max_in_flight, len(ring))
         # a bucket being served is not starving: age counts from its last
         # activity (dispatch here, slot arrival in add())
         self._queue_born[key] = self._videos_finished
         self.real_slots += len(slots)
         self.dispatched_slots += batch_size
         stats = self._bucket_stats.setdefault(
-            key, {"real_slots": 0, "dispatched_slots": 0, "stale_flushes": 0})
+            key, {"real_slots": 0, "dispatched_slots": 0, "stale_flushes": 0,
+                  "pages_dispatched": 0})
+        stats.setdefault("pages_dispatched", 0)
         stats["real_slots"] += len(slots)
         stats["dispatched_slots"] += batch_size
+        if paged:
+            stats["pages_dispatched"] += 1
+            self.pages_dispatched += 1
         if self._clock is not None:
             self._clock.add_units("packed_slots", batch_size)
             self._clock.add_units("packed_clips", len(slots))
         if self._journal is not None:
             self._journal.emit("dispatch", bucket=self._bucket_name(key),
-                               real_slots=len(slots), batch_slots=batch_size)
+                               real_slots=len(slots), batch_slots=batch_size,
+                               paged=paged, inflight=len(ring))
         if self._metrics is not None:
-            self._metrics.set_gauge(
-                "bucket_occupancy",
-                round(stats["real_slots"] / stats["dispatched_slots"], 4),
-                bucket=self._bucket_name(key))
+            occ = round(stats["real_slots"] / stats["dispatched_slots"], 4)
+            self._metrics.set_gauge("bucket_occupancy", occ,
+                                    bucket=self._bucket_name(key))
+            if paged:
+                # the page-level win (real rows / page rows, cumulative per
+                # bucket): pad waste beyond the final partial page shows up
+                # here before it shows up in the bench
+                self._metrics.set_gauge("page_occupancy", occ,
+                                        bucket=self._bucket_name(key))
+
+    def _stage_table(self, slots: List[_Slot], page_rows: int) -> np.ndarray:
+        """Row table for one page — (video id, clip idx, valid) per row,
+        filled into a reusable staging-ring buffer when a ring is wired
+        (the table rides the wire next to its page; the ring guards both
+        until the step's device values resolve)."""
+        entries = [(s.vid, s.idx) for s in slots]
+        if self._staging is None:
+            return build_row_table(entries, page_rows)
+        buf = self._staging.acquire((page_rows, TABLE_COLS), np.int32)
+        return build_row_table(entries, page_rows, out=buf)
 
     def _stage_batch(self, clips: List[np.ndarray],
                      batch_size: int) -> np.ndarray:
@@ -487,15 +595,25 @@ class CorpusPacker:
         return self._staging.stage(clips, batch_size)
 
     def _scatter_inflight(self, key: Optional[tuple] = None) -> None:
+        """Resolve EVERY unfetched batch of ``key`` (or of every key),
+        oldest first — the flush-time drain of the depth-k rings."""
         keys = [key] if key is not None else list(self._inflight)
         for k in keys:
-            inflight = self._inflight.pop(k, None)
-            if inflight is None:
-                continue
-            slots, row_of, out = inflight
-            host = self._fetch_batch(k, out)
-            for i, slot in enumerate(slots):
-                slot.assembly.put(slot.idx, host[row_of[i]])
+            ring = self._inflight.get(k)
+            while ring:
+                self._scatter_oldest(k)
+
+    def _scatter_oldest(self, key: tuple) -> None:
+        """Fetch and scatter one key's oldest unfetched batch. A fetch
+        failure drops only that batch's rows (its entry was popped) — the
+        younger in-flight entries still resolve at the flush arms."""
+        ring = self._inflight.get(key)
+        if not ring:
+            return
+        slots, row_of, fetchable = ring.popleft()
+        host = self._fetch_batch(key, fetchable)
+        for i, slot in enumerate(slots):
+            slot.assembly.put(slot.idx, host[row_of[i]])
 
     def _fetch_batch(self, key: tuple, out) -> np.ndarray:
         """Fetch one batch's device output through the extractor's
@@ -657,7 +775,8 @@ class CorpusPacker:
     def has_pending(self) -> bool:
         """True while any slot is queued or any dispatched batch is unfetched
         — the daemon's 'an idle flush would do work' signal."""
-        return (any(self._pending.values()) or bool(self._inflight))
+        return (any(self._pending.values())
+                or any(self._inflight.values()))
 
     def flush_causes(self, path: str) -> List[str]:
         """Flush-failure messages (anti-starvation or corpus-end) for the
@@ -700,6 +819,7 @@ class CorpusPacker:
                     s["real_slots"] / s["dispatched_slots"], 4)
                 if s["dispatched_slots"] else 0.0,
                 "stale_flushes": s["stale_flushes"],
+                "pages_dispatched": s.get("pages_dispatched", 0),
             }
         return out
 

@@ -1,0 +1,111 @@
+"""Ragged paged batching: fixed-size device pages + int32 row tables.
+
+The bucketed packer (:mod:`.packer`) fills fixed ``(batch_size, …)`` batches
+per ``(model, slot-shape)`` bucket and keeps ONE batch in flight per bucket —
+every corpus-flush or anti-starvation tail pays up to ``batch_size - 1``
+padding rows, and the host round trip per dispatched batch is the
+serialization point. The Ragged Paged Attention kernel work (PAPERS.md,
+arXiv:2604.15464) shows the TPU-native fix: pack variable-length work into
+fixed-size **pages** with a **row table** indexing the real rows, so one
+compiled program per bucket *family* serves clips from any number of videos
+(and any source geometry the host path normalizes into the family), with pad
+waste bounded by one partial page instead of one partial batch.
+
+Three pieces live here; the dispatch mechanics stay in
+:class:`.packer.CorpusPacker` (its paged mode):
+
+- **page geometry** — :func:`page_rows_for` sizes the page per family from
+  the model's batch budget and the in-flight depth: ``depth`` pages of
+  ``ceil(batch_size / depth)`` rows (rounded up to the mesh multiple) keep
+  the same total rows in flight as one bucketed batch while the flush tail
+  wastes at most ``page_rows - 1`` rows.
+- **row tables** — :func:`build_row_table` maps each page row to
+  ``(video, clip, valid)``: monotonically-assigned int32 video ids (host
+  side, observability + device mask), the clip's index within its video, and
+  a validity bit; padding rows are ``(-1, -1, 0)``. The table ships with the
+  page and the jitted program masks by it.
+- **the paged program** — :func:`paged_program` wraps a model's pure forward
+  ``fn(params, page) -> rows`` into ``(params, page, table) ->
+  (masked_rows, table)``. Masking multiplies every leading-axis output leaf
+  by the validity column (×1.0 for real rows — exact, byte-preserving;
+  ×0.0 zeroes padding rows on device). Passing the table through unchanged
+  is what makes **buffer donation legal**: int32 ``(page_rows, 3)`` in and
+  out, so :meth:`..parallel.mesh.MeshRunner.jit_paged` donates it and XLA
+  aliases the buffer in place — the one dispatch-path donation the uint8
+  wire format admits (``mesh.py::sharded_apply``'s documented seam).
+
+Host scatter never reads the table (slots carry their assembly references —
+slot-level fault attribution is unchanged); the table is the device-side
+contract plus the journal/bench's occupancy ground truth.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import numpy as np
+
+# row-table columns: page row -> (video id, clip idx, valid bit)
+TABLE_COLS = 3
+PAD_ROW = (-1, -1, 0)
+
+
+def page_rows_for(batch_size: int, depth: int,
+                  device_batch: Callable[[int], int] = lambda n: n) -> int:
+    """Rows per page for a family with ``batch_size`` total rows budgeted
+    across ``depth`` in-flight pages, rounded up to the mesh multiple via
+    ``device_batch`` (:meth:`..parallel.mesh.MeshRunner.device_batch`)."""
+    if depth < 1:
+        raise ValueError("pages_in_flight depth must be >= 1")
+    return device_batch(max(1, -(-batch_size // depth)))
+
+
+def build_row_table(entries: Sequence[Tuple[int, int]], page_rows: int,
+                    out: np.ndarray = None) -> np.ndarray:
+    """int32 ``(page_rows, 3)`` row table for one page.
+
+    ``entries`` are the occupied rows' ``(video_id, clip_idx)`` pairs in page
+    order; rows past ``len(entries)`` are padding (``(-1, -1, 0)``). ``out``
+    reuses a staging-ring buffer when given (the host's per-page work is a
+    fill, not an allocation)."""
+    n = len(entries)
+    if n > page_rows:
+        raise ValueError(f"{n} entries exceed the {page_rows}-row page")
+    table = np.empty((page_rows, TABLE_COLS), np.int32) if out is None else out
+    for i, (vid, idx) in enumerate(entries):
+        table[i, 0] = vid
+        table[i, 1] = idx
+        table[i, 2] = 1
+    table[n:] = PAD_ROW
+    return table
+
+
+def mask_rows(rows: Any, valid) -> Any:
+    """Multiply every leading-axis leaf of ``rows`` by the validity column.
+
+    ``valid`` is the table's int32 valid bit; the multiply is ×1.0 for real
+    rows (exact — packed outputs stay byte-identical to the bucketed loop)
+    and ×0.0 for padding rows. Pytree-aware for multi-output forwards."""
+    import jax
+
+    def mask(leaf):
+        v = valid.astype(leaf.dtype).reshape((-1,) + (1,) * (leaf.ndim - 1))
+        return leaf * v
+
+    return jax.tree_util.tree_map(mask, rows)
+
+
+def paged_program(forward: Callable[[Any, Any], Any]) -> Callable:
+    """Wrap a pure per-row ``forward(params, page)`` into the paged step
+    ``(params, page, table) -> (masked_rows, table)``.
+
+    The returned callable is what :meth:`..parallel.mesh.MeshRunner.jit_paged`
+    compiles ONCE per family: the row table (not the trace signature) carries
+    which rows are real, so every page of the family — whatever mix of videos
+    and source geometries filled it — runs this single program."""
+
+    def paged(params, page, table):
+        rows = forward(params, page)
+        return mask_rows(rows, table[:, 2]), table
+
+    return paged

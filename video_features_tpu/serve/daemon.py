@@ -1047,6 +1047,16 @@ class ExtractionService:
                     # bucket starving without tailing the daemon log)
                     "buckets": self.packer.bucket_stats(),
                     "stale_flushes": self.packer.stale_flushes,
+                    # ragged paged dispatch (parallel/pages.py; additive —
+                    # no schema bump): page count, the deepest observed
+                    # in-flight ring, and the page-level occupancy (real
+                    # rows / dispatched page rows — the page_occupancy
+                    # gauge's corpus-cumulative answer)
+                    "pages_dispatched": self.packer.pages_dispatched,
+                    "max_in_flight": self.packer.max_in_flight,
+                    "page_occupancy": (round(self.packer.occupancy, 4)
+                                       if self.packer.pages_dispatched
+                                       else 0.0),
                 },
                 # host→device staging health (ingest fast path): operators
                 # can tell a transfer-bound daemon from a decode-bound one
