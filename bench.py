@@ -1122,6 +1122,87 @@ def main() -> None:
                  f"{entry['float32_wire']['videos_per_sec']} videos/s "
                  f"({entry['bytes_ratio_f32_over_u8']}x the bytes)")
 
+    # ---- device-side preprocessing (--device_preproc) -------------------------
+    # A transform-heavy mixed-geometry resnet50 corpus with the host PIL
+    # resize+crop vs the raw-pixels wire (resize+crop fused into the jitted
+    # step). Outputs are tolerance-pinned (tests/test_device_preproc.py), so
+    # the A/B delta is WHERE the per-frame transform cost lives: VFT_METRICS
+    # is forced on so the packer's StageClock lands corpus-level per-stage
+    # seconds in _pack_stats["stage_seconds"], and the decode stage — the
+    # pool does PIL work on the host path, plain decode on the device path —
+    # must come out strictly lower with the flag on, at no-worse packing
+    # occupancy (raw wire queues key per decoded geometry; the corpus fills
+    # whole pages per geometry either way). staged bytes/video is recorded
+    # honestly: sources larger than the 224² crop ship MORE bytes raw — the
+    # win is decode-pool relief, not wire shrink (docs/performance.md). Each
+    # mode runs twice and records its second pass so per-geometry paged
+    # compiles never pollute the stage split.
+    if not over_budget("device_preproc"):
+        with guarded("device_preproc"):
+            n = 4 if on_cpu else 12
+            frames_per = 8 if on_cpu else 10
+            dp_corpus = write_corpus(
+                "device_preproc_corpus",
+                [((360, 270) if i % 2 else (400, 300), frames_per)
+                 for i in range(n)])
+            entry = {"unit": "videos", "code_rev": code_rev}
+            prev_metrics = os.environ.get("VFT_METRICS")
+            os.environ["VFT_METRICS"] = "1"
+            try:
+                for flag, key in ((False, "host_preproc"),
+                                  (True, "device_preproc")):
+                    ex = ExtractResNet50(cfg(
+                        "resnet50", batch_size=4 if on_cpu else 64,
+                        pack_corpus=True, on_extraction="save_numpy",
+                        decode_workers=1 if on_cpu else 4,
+                        device_preproc=flag))
+                    wall = None
+                    for _ in range(2):  # first pass = compile warm
+                        shutil.rmtree(ex.output_dir, ignore_errors=True)
+                        t0 = time.perf_counter()
+                        ok = ex.run(dp_corpus)
+                        wall = time.perf_counter() - t0
+                        if ok != n:
+                            raise RuntimeError(f"{key} pass extracted {ok}/{n}")
+                    stats = ex._pack_stats
+                    stages = stats.get("stage_seconds", {})
+                    entry[key] = {
+                        "videos_per_sec": round(ok / wall, 3),
+                        "wall_sec": round(wall, 3),
+                        "decode_sec_per_video": round(
+                            stages.get("decode", 0.0) / ok, 4),
+                        "transfer_sec_per_video": round(
+                            stages.get("transfer", 0.0) / ok, 4),
+                        "staged_bytes_per_video": stats["staged_bytes"] // ok,
+                        "packing_occupancy": stats["occupancy"],
+                        "n_geometry_queues": len(stats["buckets"]),
+                    }
+            finally:
+                if prev_metrics is None:
+                    os.environ.pop("VFT_METRICS", None)
+                else:
+                    os.environ["VFT_METRICS"] = prev_metrics
+            host, dev = entry["host_preproc"], entry["device_preproc"]
+            entry["decode_sec_ratio_dev_over_host"] = round(
+                dev["decode_sec_per_video"]
+                / max(host["decode_sec_per_video"], 1e-9), 3)
+            # the acceptance gates: the decode pool sheds the PIL work, and
+            # per-geometry queues cost no packing occupancy
+            entry["decode_strictly_lower"] = (
+                dev["decode_sec_per_video"] < host["decode_sec_per_video"])
+            entry["occupancy_no_worse"] = (
+                dev["packing_occupancy"] >= host["packing_occupancy"])
+            details["device_preproc"] = entry
+            clear_failure("device_preproc")
+            flush_details()
+            _log(f"device_preproc: decode "
+                 f"{dev['decode_sec_per_video']}s/video vs host "
+                 f"{host['decode_sec_per_video']}s/video "
+                 f"(ratio {entry['decode_sec_ratio_dev_over_host']}, "
+                 f"strictly lower: {entry['decode_strictly_lower']}), "
+                 f"occupancy {dev['packing_occupancy']} vs "
+                 f"{host['packing_occupancy']}")
+
     # ---- always-on service (--serve) steady state -----------------------------
     # A stream of staggered small requests through the daemon's warm slot
     # queues vs the SAME corpus as one batch --pack_corpus run: the serving

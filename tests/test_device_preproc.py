@@ -1,0 +1,372 @@
+"""--device_preproc: device-side preprocessing everywhere.
+
+The numerics contracts, per model class (config.py / cache/key.py):
+
+- flow (raft/pwc): the geometry pad moves on-device
+  (``models/raft.device_pad_to_shape``) — replicate-pad on the uint8 wire is
+  arithmetic-free, so outputs are BYTE-identical to the host pad
+  (execution-only in the cache key);
+- vggish: the log-mel DSP runs as a fused jitted prologue
+  (``ops/audio.log_mel_examples``) over raw PCM slabs — float32 device math
+  vs the float64 numpy oracle, pinned ≤ 2e-5 (fingerprints);
+- i3d: the PIL edge resize moves on-device
+  (``ops/image.device_edge_resize_hwc``) — tolerance-gated like resnet50's
+  ``--device_resize`` (≤ 2 uint8 levels max, ≤ 1 mean; fingerprints);
+- resnet50: the flag IS ``--device_resize`` (one key component);
+- r21d: documented no-op (the transform has been device-fused since the
+  port).
+
+Compile budget: the host-side contracts (pad bytes, slab framing, key
+resolution, routing) run stub-level; the model-level pins compile one tiny
+RAFT geometry (shared between the per-video and packed runs) and the small
+VGGish net.
+"""
+
+# fast-registry: default tier — device-preproc parity over real-model compiles
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from video_features_tpu.config import ExtractionConfig
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _random_weights():
+    mp = pytest.MonkeyPatch()
+    mp.setenv("VFT_ALLOW_RANDOM_WEIGHTS", "1")
+    yield
+    mp.undo()
+
+
+def _cfg(tmp_path, feature_type, **kw):
+    return ExtractionConfig(
+        feature_type=feature_type, num_devices=1,
+        output_path=str(tmp_path / "out"), tmp_path=str(tmp_path / "tmp"),
+        **kw)
+
+
+def _write_video(path, n_frames, size=(24, 16), seed=7):
+    import cv2
+
+    wr = cv2.VideoWriter(str(path), cv2.VideoWriter_fourcc(*"mp4v"),
+                         10.0, size)
+    rng = np.random.default_rng(seed)
+    for _ in range(n_frames):
+        wr.write(rng.integers(0, 256, (size[1], size[0], 3), dtype=np.uint8))
+    wr.release()
+    return str(path)
+
+
+# ---- device pad: byte-exact vs the host oracle ------------------------------
+
+
+def test_device_pad_byte_identical_to_host_pad():
+    """device_pad_to_shape == pad_to_shape bit for bit on the uint8 wire —
+    replicate-pad is pure copying, which is WHY the flag is execution-only
+    for flow in cache/key.py."""
+    from video_features_tpu.models.raft import (
+        device_pad_to_shape, pad_split, pad_to_shape)
+
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (3, 13, 17, 3), dtype=np.uint8)
+    for target in ((16, 24), (13, 17), (14, 17), (13, 20)):
+        host = np.stack([pad_to_shape(f, target)[0] for f in frames])
+        dev = np.asarray(device_pad_to_shape(jnp.asarray(frames), target))
+        assert dev.dtype == np.uint8
+        np.testing.assert_array_equal(dev, host)
+        # the host keeps only the arithmetic: pad_split matches what
+        # pad_to_shape reported, so finalize's unpad stays correct
+        assert pad_split(13, 17, *target) == pad_to_shape(frames[0], target)[1]
+    with pytest.raises(ValueError, match="cannot pad"):
+        device_pad_to_shape(jnp.asarray(frames), (8, 8))
+
+
+# ---- vggish: slab framing + jitted log-mel ----------------------------------
+
+
+def test_pcm_slab_count_matches_example_count():
+    """The wire-format equivalence: framing raw 16 kHz samples with
+    (15600, 15360) yields exactly one slab per host log-mel example — both
+    tail-dropping framing stages admit example k iff n ≥ k·15360 + 15600."""
+    from video_features_tpu.audio import melspec
+
+    rng = np.random.default_rng(1)
+    for n in (0, 100, 15599, 15600, 15601, 30959, 30960, 30961, 46320, 50000):
+        wav = rng.standard_normal(n)
+        n_examples = melspec.waveform_to_examples(wav, 16000).shape[0]
+        slabs = melspec.waveform_to_pcm_slabs(wav, 16000)
+        assert slabs.shape == (n_examples, melspec.SAMPLES_PER_EXAMPLE), n
+        assert slabs.dtype == np.float32
+        # each slab IS the raw window the host DSP consumed for that example
+        for k in range(n_examples):
+            start = k * melspec.EXAMPLE_HOP_SAMPLES
+            np.testing.assert_array_equal(
+                slabs[k],
+                wav[start:start + melspec.SAMPLES_PER_EXAMPLE]
+                .astype(np.float32))
+
+
+def test_log_mel_examples_matches_host_oracle_within_2e5():
+    """The jitted log-mel (f32 framing→|rfft|→mel matmul→log) vs the numpy
+    f64 oracle over resampled audio: ≤ 2e-5 everywhere. The floor is the
+    complex64 FFT's cancellation noise on high-dynamic-range spectra
+    (~1.1e-5 worst observed on the noise+tone case; wire quantization and
+    the HIGHEST-precision mel matmul each contribute < 1e-6); the quiet
+    pure tone covers off-band bins near the log-offset floor."""
+    from video_features_tpu.audio import melspec
+    from video_features_tpu.ops.audio import log_mel_examples
+
+    rng = np.random.default_rng(2)
+    n = 44100 * 2 + 1234  # 44.1 kHz source: exercises the resample front half
+    t = np.arange(n) / 44100.0
+    cases = (
+        0.1 * rng.standard_normal(n) + 0.5 * np.sin(2 * np.pi * 440 * t),
+        0.01 * np.sin(2 * np.pi * 3000 * t),  # quiet pure tone
+    )
+    for wav in cases:
+        host = melspec.waveform_to_examples(wav, 44100)
+        slabs = melspec.waveform_to_pcm_slabs(wav, 44100)
+        assert host.shape[0] == slabs.shape[0] > 0
+        dev = np.asarray(log_mel_examples(jnp.asarray(slabs)))
+        assert dev.shape == host.shape
+        assert np.abs(dev - host).max() <= 2e-5
+
+
+def test_vggish_device_preproc_embedding_parity(tmp_path):
+    """End to end through the real VGG stack: --device_preproc embeddings
+    track the host-DSP embeddings to float32-noise levels (the ≤1e-5 log-mel
+    drift does not amplify through the conv stack)."""
+    from scipy.io import wavfile
+
+    from video_features_tpu.extractors.vggish import ExtractVGGish
+
+    rng = np.random.default_rng(3)
+    n = 16000 * 2  # 2 s at 16 kHz → 2 examples
+    wav = (0.2 * rng.standard_normal(n)).clip(-1, 1)
+    wav_path = str(tmp_path / "a.wav")
+    wavfile.write(wav_path, 16000, (wav * 32767).astype(np.int16))
+
+    host = ExtractVGGish(_cfg(tmp_path / "h", "vggish")).extract(wav_path)
+    dev_ex = ExtractVGGish(_cfg(tmp_path / "d", "vggish",
+                                device_preproc=True))
+    dev = dev_ex.extract(wav_path)
+    assert dev["vggish"].shape == host["vggish"].shape == (2, 128)
+    np.testing.assert_allclose(dev["vggish"], host["vggish"],
+                               atol=5e-4, rtol=0)
+    # routing: the packed seam ships (N, 15600) raw PCM slots under the flag
+    info, clips = dev_ex.pack_spec().open_clips(wav_path)
+    rows = list(clips)
+    assert rows and rows[0].shape == (15600,)
+
+
+# ---- i3d: device edge resize ------------------------------------------------
+
+
+def test_i3d_device_edge_resize_within_documented_tolerance():
+    """device_edge_resize_hwc over a clip stack vs per-frame PIL: the same
+    ≤ 2 uint8 levels max / ≤ 1 mean gate as resnet50's --device_resize, for
+    both down- and up-scaling, with crop-free geometry (the i3d flow stream
+    crops only after the flow net)."""
+    from video_features_tpu.ops.image import (
+        device_edge_resize_hwc, edge_resize_size, pil_edge_resize)
+
+    rng = np.random.default_rng(5)
+    for geom in ((37, 53), (20, 28)):  # downscale and upscale to edge 32
+        stack = rng.integers(0, 256, (2, 4) + geom + (3,), dtype=np.uint8)
+        host = np.stack([[pil_edge_resize(f, 32) for f in clip]
+                         for clip in stack]).astype(np.float32)
+        dev = np.asarray(device_edge_resize_hwc(jnp.asarray(stack), 32))
+        ow, oh = edge_resize_size(geom[1], geom[0], 32)
+        assert dev.shape == (2, 4, oh, ow, 3) and dev.dtype == np.float32
+        diff = np.abs(host - dev)
+        assert diff.max() <= 2.0, f"{geom}: max drift {diff.max()}"
+        assert diff.mean() <= 1.0, f"{geom}: mean drift {diff.mean()}"
+
+
+# ---- routing + notices ------------------------------------------------------
+
+
+def test_device_preproc_routing_and_notices(tmp_path, capsys):
+    """Every feature type supports the flag (raw host transforms where a
+    device path exists, documented no-op for r21d), so no ignored-flag
+    notice prints; the base-class notice still fires for a model that opts
+    out; and --device_preproc implies resnet50's device resize."""
+    from video_features_tpu.extractors.flow import ExtractFlow
+    from video_features_tpu.extractors.i3d import ExtractI3D
+    from video_features_tpu.extractors.r21d import ExtractR21D
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+
+    raw = np.random.default_rng(0).integers(
+        0, 256, (30, 40, 3), dtype=np.uint8)
+    rn = ExtractResNet50(_cfg(tmp_path / "rn", "resnet50",
+                              device_preproc=True))
+    assert rn._device_resize and rn._host_transform(raw) is raw
+    i3 = ExtractI3D(_cfg(tmp_path / "i3", "i3d", streams=("rgb",),
+                         i3d_pre_crop_size=64, i3d_crop_size=32,
+                         device_preproc=True))
+    assert i3._host_transform(raw) is raw
+    i3_host = ExtractI3D(_cfg(tmp_path / "i3h", "i3d", streams=("rgb",),
+                              i3d_pre_crop_size=64, i3d_crop_size=32))
+    assert i3_host._host_transform(raw).shape[0] == 64  # smaller edge → 64
+    ExtractR21D(_cfg(tmp_path / "r2", "r21d_rgb", device_preproc=True))
+    ExtractFlow(_cfg(tmp_path / "fl", "pwc", batch_size=2,
+                     device_preproc=True))
+    assert "--device_preproc ignored" not in capsys.readouterr().out
+
+    # the base-class notice fires for models without a device path
+    class _OptedOut(ExtractFlow):
+        supports_device_preproc = False
+
+    _OptedOut(_cfg(tmp_path / "oo", "pwc", batch_size=2,
+                   device_preproc=True))
+    assert "--device_preproc ignored" in capsys.readouterr().out
+
+
+def test_flow_window_stages_raw_geometry(tmp_path):
+    """--device_preproc flow windows stage at the RAW decoded geometry (the
+    staging ring keys by decode size, not the padded target) and dispatch
+    through the per-pad-target step with the host keeping only the pad
+    arithmetic for the final unpad."""
+    from video_features_tpu.extractors.flow import ExtractFlow
+
+    ex = ExtractFlow(_cfg(tmp_path, "raft", batch_size=2,
+                          device_preproc=True))
+    seen = {}
+
+    def fake_step(params, dev):
+        seen["shape"] = tuple(dev.shape)
+        seen["dtype"] = str(dev.dtype)
+        # the per-target step's contract: flow comes back at the PADDED target
+        return jnp.zeros((dev.shape[0] - 1, 16, 24, 2), jnp.float32)
+
+    ex._frames_step_for = lambda target, sharded: (
+        seen.setdefault("target", (tuple(target), sharded)) and None
+        or fake_step)
+    window = list(np.random.default_rng(1).integers(
+        0, 256, (3, 13, 17, 3), dtype=np.uint8))
+    flow, n_pairs, pads = ex._dispatch_window(window)
+    assert seen["shape"] == (3, 13, 17, 3)  # raw geometry on the wire
+    assert seen["dtype"] == "uint8"
+    assert seen["target"] == ((16, 24), False)  # /8 pad target, single-device
+    assert n_pairs == 2 and pads == (1, 2, 3, 4)  # centered /8 split
+    assert (3, 13, 17, 3) in {k[0] for k in ex._staging._rings}
+
+
+def test_cache_key_resolution_for_device_preproc():
+    """The keying decision, per model: fingerprints where the device
+    preprocess drifts (i3d, vggish), folds into device_resize for resnet50,
+    and never splits keys for the byte-exact (raft/pwc) or no-op (r21d)
+    paths."""
+    from video_features_tpu.cache.key import config_fingerprint
+
+    def fp(ft, **kw):
+        return config_fingerprint(ExtractionConfig(feature_type=ft, **kw))
+
+    for ft in ("raft", "pwc", "r21d_rgb"):
+        on, off = fp(ft, device_preproc=True), fp(ft)
+        assert on["device_preproc"] is False and on == off, ft
+    for ft in ("i3d", "vggish"):
+        assert fp(ft, device_preproc=True) != fp(ft), ft
+        assert fp(ft, device_preproc=True)["device_preproc"] is True
+    # resnet50: one key component for both spellings
+    assert (fp("resnet50", device_preproc=True)
+            == fp("resnet50", device_resize=True))
+    assert fp("resnet50", device_preproc=True)["device_resize"] is True
+    assert fp("resnet50", device_preproc=True)["device_preproc"] is False
+    assert fp("resnet50", device_preproc=True) != fp("resnet50")
+
+
+# ---- model-level parity pins ------------------------------------------------
+
+
+def test_raft_device_pad_byte_parity_per_video_and_packed(tmp_path):
+    """The acceptance pin for flow: --device_preproc outputs are
+    byte-identical to the host-pad path through the real RAFT net, in both
+    the per-video loop and a packed run (which reuses the same per-target
+    jit signature: raw (18, 30) input, (24, 32) pad target)."""
+    from video_features_tpu.extractors.flow import ExtractFlow
+    from video_features_tpu.io.output import feature_output_dir
+
+    # 30×18 frames: both axes off the /8 contract, so the pad is real
+    corpus = [_write_video(tmp_path / f"v{i}.mp4", n, size=(30, 18),
+                           seed=10 + i) for i, n in enumerate((4, 3))]
+
+    def run(sub, **kw):
+        cfg = ExtractionConfig(
+            feature_type="raft", batch_size=2, num_devices=1,
+            on_extraction="save_numpy",
+            output_path=str(tmp_path / sub), tmp_path=str(tmp_path / "tmp"),
+            **kw)
+        ex = ExtractFlow(cfg)
+        assert ex.run(corpus) == len(corpus)
+        return ex, {os.path.basename(f): np.load(f) for f in
+                    glob.glob(str(tmp_path / sub / "raft" / "*.npy"))}
+
+    _, host = run("host")
+    dev_ex, dev = run("dev", device_preproc=True)
+    assert set(host) == set(dev) and host
+    for k in host:
+        assert host[k].shape == dev[k].shape, k
+        assert host[k].tobytes() == dev[k].tobytes(), k
+    # packed run through the same instance: raw-wire pairs, same programs
+    dev_ex.cfg = dev_ex.cfg.replace(pack_corpus=True,
+                                    output_path=str(tmp_path / "devp"))
+    dev_ex.output_dir = feature_output_dir(str(tmp_path / "devp"), "raft")
+    assert dev_ex.run(corpus) == len(corpus)
+    packed = {os.path.basename(f): np.load(f) for f in
+              glob.glob(str(tmp_path / "devp" / "raft" / "*.npy"))}
+    assert set(packed) == set(host)
+    for k in host:
+        assert host[k].tobytes() == packed[k].tobytes(), k
+    # raw decode size keys the rings; the /8 target exists only on device
+    assert any(k[0][1:3] == (18, 30) for k in dev_ex._staging._rings)
+
+
+def test_resnet_device_preproc_paged_raw_wire(tmp_path):
+    """resnet50 raw-wire frames now ride the PAGED dispatch path (the old
+    per-model opt-out was overcautious — queues key by geometry, so pages
+    never co-host mixed shapes): a mixed-geometry corpus under
+    --device_preproc pages per-queue and matches the per-video loop to
+    float32 ulp level. NOT byte-for-byte: pages run the forward at
+    page_rows (≠ the per-video batch), and XLA makes no cross-shape bitwise
+    guarantee for the f32 resize prologue — consistent with the flag's
+    fingerprint classification (measured ~2e-7 relative; pinned 1e-5)."""
+    from video_features_tpu.extractors.resnet import ExtractResNet50
+    from video_features_tpu.io.output import feature_output_dir
+
+    corpus = [_write_video(tmp_path / "a.mp4", 3, size=(24, 16), seed=1),
+              _write_video(tmp_path / "b.mp4", 3, size=(16, 24), seed=2)]
+    cfg = ExtractionConfig(
+        feature_type="resnet50", batch_size=2, num_devices=1,
+        on_extraction="save_numpy", device_preproc=True,
+        output_path=str(tmp_path / "u"), tmp_path=str(tmp_path / "tmp"))
+    ex = ExtractResNet50(cfg)
+    assert ex.run(corpus) == len(corpus)
+    ex.cfg = ex.cfg.replace(pack_corpus=True,
+                            output_path=str(tmp_path / "p"))
+    ex.output_dir = feature_output_dir(str(tmp_path / "p"), "resnet50")
+    assert ex.run(corpus) == len(corpus)
+
+    def load(sub):
+        return {os.path.basename(f): np.load(f) for f in
+                glob.glob(str(tmp_path / sub / "resnet50" / "*.npy"))}
+
+    unpacked, packed = load("u"), load("p")
+    assert set(unpacked) == set(packed) and unpacked
+    for k in unpacked:
+        u, p = unpacked[k], packed[k]
+        assert u.shape == p.shape, k
+        if "resnet50" in k:  # feature rows: ulp-level, not byte-for-byte
+            scale = max(1.0, float(np.abs(u).max()))
+            assert np.abs(u - p).max() <= 1e-5 * scale, k
+        else:  # fps/timestamps sidecars stay byte-exact
+            assert u.tobytes() == p.tobytes(), k
+    # the paged path carried the raw-wire slots: one queue per raw geometry,
+    # pages dispatched for both
+    assert ex._pack_stats["pages_dispatched"] > 0
+    assert len(ex._pack_stats["buckets"]) == 2  # (16,24) and (24,16) queues

@@ -30,7 +30,14 @@ driving the REAL CLI surface as an operator would — no test harness imports:
    ``rejected`` record for a request naming an unloaded model;
 5. SIGTERM drains it, and the script asserts exit code 0, ``done`` result
    records for every request, complete per-model done-manifests, and
-   byte-identical ``.npy`` outputs against the batch runs.
+   byte-identical ``.npy`` outputs against the batch runs;
+6. a second, dedicated daemon runs with ``--device_preproc`` (the raw-pixels
+   wire — docs/performance.md) and serves one mixed-geometry request: the
+   outputs must track a ``--device_preproc`` batch run to float32 ulp level
+   (the daemon's paged dispatch runs the fused resize at page shape, so
+   byte-parity is not the contract there), and the ``stats`` op must report
+   the decode/transfer stage split — the operator meter showing the decode
+   pool shed the per-frame PIL work.
 
 Runs on CPU with deterministic random weights::
 
@@ -331,9 +338,64 @@ def main() -> int:
     print(f"[smoke] trace ok: {len(req_spans)} request spans, "
           f"{len(per_video)} per-video spans")
 
+    # --device_preproc serving: a dedicated daemon with the raw-pixels wire
+    # on, one mixed-geometry request, parity vs a --device_preproc batch run
+    print("[smoke] --device_preproc daemon: one mixed-geometry request")
+    dave_videos = [write_video(os.path.join(root, "d0.mp4"), 4),
+                   write_video(os.path.join(root, "d1.mp4"), 5,
+                               size=(48, 36))]
+    subprocess.run(cli(os.path.join(root, "batch_dave"), "--device_preproc",
+                       "--video_paths", *dave_videos),
+                   env=env, check=True, timeout=TIMEOUT)
+    spool2 = os.path.join(root, "spool_dp")
+    os.makedirs(spool2)
+    dp_out = os.path.join(root, "serve_dp")
+    daemon2 = subprocess.Popen(
+        cli(dp_out, "--serve", "--spool_dir", spool2, "--device_preproc",
+            "--idle_flush_sec", "0.05", "--spool_poll_sec", "0.05"),
+        env=env)
+    try:
+        drop_request(spool2, "req_dave",
+                     {"tenant": "dave", "videos": dave_videos})
+        dave = os.path.join(spool2, "results", "req_dave.result.json")
+        await_results(daemon2, [dave], time.time() + TIMEOUT)
+        with open(dave) as f:
+            record = json.load(f)
+        assert record["state"] == "done", record
+        # the stats op's per-stage split: decode ran (and, with the raw
+        # wire, did NO PIL work — the resize is fused into the step), and
+        # the host→device transfer stage is accounted separately
+        stats_dp = sock_op(os.path.join(spool2, "control.sock"),
+                           {"op": "stats"})
+        stages = stats_dp["stages"]
+        assert stages.get("decode", 0) > 0, stages
+        assert "transfer" in stages, stages
+        assert stats_dp["transfer"]["bytes"] > 0, stats_dp["transfer"]
+        print(f"[smoke] device_preproc stage split: decode "
+              f"{stages['decode']}s, transfer {stages['transfer']}s "
+              f"({stats_dp['transfer']['bytes']} B staged)")
+        daemon2.send_signal(signal.SIGTERM)
+        assert daemon2.wait(timeout=TIMEOUT) == 0, daemon2.returncode
+    finally:
+        if daemon2.poll() is None:
+            daemon2.kill()
+            daemon2.wait()
+    got_dp = outputs(dp_out)
+    want_dp = outputs(os.path.join(root, "batch_dave"))
+    assert set(got_dp) == set(want_dp) and got_dp, (sorted(got_dp),
+                                                    sorted(want_dp))
+    for name in sorted(want_dp):
+        w, g = want_dp[name], got_dp[name]
+        assert w.shape == g.shape, name
+        scale = max(1.0, float(np.abs(w).max()))
+        assert np.abs(w - g).max() <= 1e-5 * scale, \
+            f"{name}: device_preproc daemon output drifts past ulp level"
+    print(f"[smoke] device_preproc outputs track the batch run "
+          f"({len(want_dp)} files, ulp-level)")
+
     print(f"[smoke] PASS: {len(want)} + {len(want_r)} outputs "
           "byte-identical across two co-resident models, manifests intact, "
-          "telemetry trace complete")
+          "telemetry trace complete, device_preproc serving verified")
     return 0
 
 

@@ -68,6 +68,8 @@ LOCK_NAMES: Dict[str, str] = {
     "video_features_tpu/parallel/pipeline.py:slot['lock']": "slot",
     "video_features_tpu/extractors/flow.py:ExtractFlow._precompile_lock":
         "precompile",
+    "video_features_tpu/extractors/flow.py:ExtractFlow._frames_steps_lock":
+        "flow-steps",
     "video_features_tpu/reliability/faults.py:_lock": "faults",
 }
 

@@ -28,6 +28,7 @@ from ..core import Finding, Rule, SourceFile, register
 DEFAULT_TIER: Dict[str, str] = {
     "test_bench_record": "bench record/merge logic drives jitted extractors",
     "test_decode_pool": "real-sleep concurrency tests on the decode pool",
+    "test_device_preproc": "device-preproc parity over real-model compiles",
     "test_fault_injection": "e2e extraction under injected faults (compiles)",
     "test_flow_bf16": "bf16 drift measurement over flow compiles",
     "test_flow_frames": "shared-frame flow forward parity (flow compiles)",

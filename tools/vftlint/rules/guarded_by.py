@@ -86,6 +86,7 @@ GUARDED_BY: Dict[str, Dict[str, str]] = {
     },
     "video_features_tpu/extractors/flow.py": {
         "self._precompiled": "precompile",
+        "self._frames_steps": "flow-steps",
     },
     "video_features_tpu/reliability/faults.py": {
         "_cached_spec": "faults",

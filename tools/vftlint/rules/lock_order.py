@@ -52,6 +52,8 @@ LOCK_ORDER: List[str] = [
     "resize",     # parallel/pipeline.py DecodePrefetcher._resize_lock
     "slot",       # parallel/pipeline.py decode slot['lock'] (byte cap)
     "precompile",  # extractors/flow.py ExtractFlow._precompile_lock
+    "flow-steps",  # extractors/flow.py ExtractFlow._frames_steps_lock
+                   # (--device_preproc per-pad-target step memo; a leaf)
     "faults",     # reliability/faults.py module _lock
 ]
 

@@ -13,8 +13,11 @@ Behavioral spec — ``/root/reference/models/vggish/vggish_src/``:
   (``vggish_input.py:68-87``) with the same kaiser-windowed-sinc algorithm the
   reference pins (:mod:`video_features_tpu.audio.resample`).
 
-This stays host-side numpy: the DSP is microseconds per clip next to the VGG
-forward, and numpy keeps it bit-comparable with the reference's own numpy frontend.
+This numpy implementation is the default host path AND the parity oracle for
+the device-side pipeline: under ``--device_preproc`` the host ships raw
+(N, 15600) PCM slabs (:func:`waveform_to_pcm_slabs`) and the log-mel runs as a
+fused jitted prologue (:mod:`video_features_tpu.ops.audio`), pinned ≤2e-5
+against this module's float64 math in tests/test_device_preproc.py.
 """
 
 from __future__ import annotations
@@ -30,6 +33,16 @@ MEL_MAX_HZ = 7500.0
 LOG_OFFSET = 0.01
 EXAMPLE_WINDOW_SECS = 0.96
 EXAMPLE_HOP_SECS = 0.96
+
+# --device_preproc wire geometry (ops/audio.py consumes these): one (96, 64)
+# example reads 95·160 + 400 = 15600 samples and the next example starts
+# 96·160 = 15360 samples later. Both of melspec's tail-dropping framing
+# stages (samples→STFT frames, frames→examples) admit example k iff
+# n ≥ k·15360 + 15600 — the same predicate as framing the raw waveform
+# directly with (15600, 15360), so PCM slabs are example-for-example
+# equivalent to host log-mel examples (pinned in tests/test_device_preproc.py).
+SAMPLES_PER_EXAMPLE = 15600
+EXAMPLE_HOP_SAMPLES = 15360
 
 _MEL_BREAK_FREQUENCY_HERTZ = 700.0
 _MEL_HIGH_FREQUENCY_Q = 1127.0
@@ -103,8 +116,9 @@ def log_mel_spectrogram(data: np.ndarray, audio_sample_rate: float = SAMPLE_RATE
     return np.log(mel + log_offset)
 
 
-def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
-    """[-1,1] waveform (mono or channels-last stereo) → (N, 96, 64) float32."""
+def _mono_16k(data: np.ndarray, sample_rate: float) -> np.ndarray:
+    """Shared front half of both wire formats: stereo → mono mean, resample
+    to 16 kHz with the reference-pinned kaiser-windowed sinc."""
     if data.ndim > 1:
         data = np.mean(data, axis=1)
     if sample_rate != SAMPLE_RATE:
@@ -112,9 +126,15 @@ def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
 
         if output_length(data.shape[0], sample_rate, SAMPLE_RATE) < 1:
             data = np.zeros(0, np.float64)  # degenerate/empty audio track:
-            # keep the (0, 96, 64) empty-examples contract of the 16 kHz path
+            # keep the (0, ...) empty contract of the 16 kHz path
         else:
             data = resample(data, sample_rate, SAMPLE_RATE)
+    return data
+
+
+def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
+    """[-1,1] waveform (mono or channels-last stereo) → (N, 96, 64) float32."""
+    data = _mono_16k(data, sample_rate)
     log_mel = log_mel_spectrogram(data)
     features_rate = 1.0 / STFT_HOP_SECS
     window = int(round(EXAMPLE_WINDOW_SECS * features_rate))
@@ -122,11 +142,37 @@ def waveform_to_examples(data: np.ndarray, sample_rate: float) -> np.ndarray:
     return frame(log_mel, window, hop).astype(np.float32)
 
 
-def wav_to_examples(wav_path: str) -> np.ndarray:
-    """16-bit PCM wav → examples (vggish_input.py:74-87 semantics via scipy)."""
+def waveform_to_pcm_slabs(data: np.ndarray, sample_rate: float) -> np.ndarray:
+    """[-1,1] waveform → (N, 15600) float32 raw-PCM example slabs.
+
+    The ``--device_preproc`` wire format: slab k covers 16 kHz samples
+    [k·15360, k·15360 + 15600) and :func:`video_features_tpu.ops.audio.
+    log_mel_examples` turns the batch into (N, 96, 64) log-mel on device.
+    Example-for-example equivalent to :func:`waveform_to_examples` (same
+    mono/resample front half; framing identity documented at
+    SAMPLES_PER_EXAMPLE above).
+    """
+    data = _mono_16k(data, sample_rate)
+    return frame(np.ascontiguousarray(data),
+                 SAMPLES_PER_EXAMPLE, EXAMPLE_HOP_SAMPLES).astype(np.float32)
+
+
+def _read_wav(wav_path: str) -> tuple:
     from scipy.io import wavfile
 
     sr, data = wavfile.read(wav_path)
     if data.dtype != np.int16:
         raise ValueError(f"{wav_path}: expected 16-bit PCM, got {data.dtype}")
-    return waveform_to_examples(data / 32768.0, sr)
+    return sr, data / 32768.0
+
+
+def wav_to_examples(wav_path: str) -> np.ndarray:
+    """16-bit PCM wav → examples (vggish_input.py:74-87 semantics via scipy)."""
+    sr, data = _read_wav(wav_path)
+    return waveform_to_examples(data, sr)
+
+
+def wav_to_pcm_slabs(wav_path: str) -> np.ndarray:
+    """16-bit PCM wav → (N, 15600) float32 slabs (``--device_preproc`` wire)."""
+    sr, data = _read_wav(wav_path)
+    return waveform_to_pcm_slabs(data, sr)

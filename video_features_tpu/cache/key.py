@@ -53,6 +53,16 @@ FINGERPRINT_FIELDS = (
     "i3d_pre_crop_size",       # i3d resize target
     "i3d_crop_size",           # i3d center crop
     "device_resize",           # resolved: jax.image.resize vs PIL drifts
+    "device_preproc",          # resolved: fingerprints only where the device
+                               # preprocess is inexact vs the host oracle —
+                               # i3d (jax.image.resize vs PIL drifts, like
+                               # device_resize) and vggish (f32 log-mel vs
+                               # the f64 numpy DSP, ≤2e-5 but not byte-
+                               # exact). resnet50 folds into device_resize
+                               # (same path, one key). raft/pwc resolve
+                               # False: replicate-pad on the uint8 wire is
+                               # BYTE-exact (tests/test_device_preproc.py).
+                               # r21d resolves False: documented no-op.
 )
 
 # Fields declared NOT to affect feature bytes. Each carries its reason; the
@@ -182,8 +192,19 @@ def config_fingerprint(cfg) -> Dict[str, object]:
         elif name == "device_resize":
             # only resnet50 has a device-resize path; other feature types
             # print a notice and keep the (parity) host resize, so the flag
-            # must not split their keys
-            value = bool(value) if cfg.feature_type == "resnet50" else False
+            # must not split their keys. --device_preproc IS the resize for
+            # resnet50 (extractors/resnet.py ORs the two flags), so either
+            # spelling lands on this one key component
+            value = (bool(value or cfg.device_preproc)
+                     if cfg.feature_type == "resnet50" else False)
+        elif name == "device_preproc":
+            # fingerprints only where the device preprocess drifts from the
+            # host oracle (see the FINGERPRINT_FIELDS rationale): i3d's
+            # device resize and vggish's f32 log-mel. resnet50 already
+            # resolved into device_resize above; raft/pwc's device pad is
+            # byte-exact and r21d's is a no-op — their keys must not split
+            value = (bool(value)
+                     if cfg.feature_type in ("i3d", "vggish") else False)
         elif isinstance(value, tuple):
             value = list(value)
         fp[name] = value

@@ -105,7 +105,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "step (jax.image.resize) — removes the host PIL "
                              "resize cost; NOT bit-identical to the PIL path "
                              "(documented tolerance, docs/performance.md); "
-                             "off = bit-parity")
+                             "off = bit-parity. --device_preproc is the "
+                             "every-model generalization")
+    parser.add_argument("--device_preproc", action="store_true", default=False,
+                        help="move every remaining host-side preprocess "
+                             "inside the jitted step (generalizes "
+                             "--device_resize to all feature types): "
+                             "resnet50/i3d resize on device (documented "
+                             "tolerance), raft/pwc ship raw frames and "
+                             "replicate-pad on device (byte-exact), vggish "
+                             "ships raw PCM and computes the log-mel on "
+                             "device (<=2e-5 vs the numpy oracle); r21d has "
+                             "been fully device-side since its port. Frees "
+                             "the decode pool from per-frame PIL/numpy work "
+                             "at more host->device bytes per video "
+                             "(docs/performance.md ingest fast path)")
     parser.add_argument("--transfer_dtype", default="float32",
                         choices=["float32", "float16", "bfloat16"],
                         help="raft/pwc: cast dense flow to this on device "
@@ -156,11 +170,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "padded batches (one in flight) instead of "
                              "fixed-size pages with an int32 row table and "
                              "a donated table buffer. Paged dispatch is on "
-                             "by default for the shape-compatible paths "
-                             "(resnet50, r21d, i3d stacks, vggish); collate "
-                             "models (raft/pwc, i3d flow sandwich) and "
-                             "--device_resize resnet always dispatch "
-                             "bucketed — docs/performance.md")
+                             "by default for the slot-shaped paths "
+                             "(resnet50 — including raw-wire "
+                             "--device_resize/--device_preproc frames, "
+                             "r21d, i3d stacks, vggish), with "
+                             "mixed-geometry slots paging per-queue under "
+                             "one compiled family; the collate models "
+                             "(raft/pwc) always dispatch bucketed — "
+                             "docs/performance.md")
     parser.add_argument("--pages_in_flight", type=int, default=2,
                         help="paged dispatch: in-flight pages per bucket "
                              "(page_rows = ceil(batch budget / depth), so "

@@ -101,10 +101,12 @@ class ExtractionConfig:
     # batch_size padded batches, keep pages_in_flight pages in flight per
     # bucket, and donate the row table's device buffer (mesh.py jit_paged).
     # Outputs stay byte-identical to bucketed dispatch (tests/test_paged.py);
-    # pad waste drops to at most one partial page per flush. Models whose
-    # wire format is geometry-variable on device (--device_resize resnet) or
-    # that collate their own windows (raft/pwc, the i3d flow sandwich) opt
-    # out per PackSpec and dispatch bucketed exactly as before.
+    # pad waste drops to at most one partial page per flush. Raw-pixels wire
+    # formats (--device_resize / --device_preproc) page too — queues key by
+    # decoded geometry, so pages never co-host mixed shapes (ulp-level vs
+    # the per-video loop, tests/test_device_preproc.py). Models that collate
+    # their own windows (raft/pwc, the i3d flow sandwich) opt out per
+    # PackSpec and dispatch bucketed exactly as before.
     paged_batching: bool = True
     # Paged in-flight depth per bucket: the host refills page k+1's staging
     # buffer while the device chews on page k (>= 2 = double-buffered
@@ -211,8 +213,26 @@ class ExtractionConfig:
     # tolerance pinned in tests/test_ingest.py, documented in
     # docs/performance.md), so off by default per the ops/image.py parity
     # contract. Packed runs queue slots per decoded geometry (like i3d);
-    # other feature types print a notice and keep the host path.
+    # other feature types route the same idea through --device_preproc.
     device_resize: bool = False
+    # Device-side preprocessing everywhere (generalizes --device_resize
+    # from resnet50 to every feature type — ROADMAP item 4 completed): each
+    # model ships its RAWEST wire format and runs the remaining host-side
+    # transform as a fused prologue op inside the jitted step, so the
+    # CPU-bound decode pool stops paying per-frame PIL/numpy costs.
+    # Per model: resnet50 behaves exactly as --device_resize; i3d moves the
+    # PIL edge resize on device (ops/image.device_edge_resize_hwc,
+    # tolerance-gated like resnet's — fingerprints); raft/pwc ship RAW
+    # decoded frames and replicate-pad to the /8 (or bucket) geometry on
+    # device (models/raft.device_pad_to_shape on the uint8 wire — BYTE-exact
+    # vs the host pad, execution-only); vggish ships raw PCM slabs and runs
+    # the log-mel STFT/mel pipeline on device (ops/audio.log_mel_examples,
+    # ≤2e-5 vs the numpy oracle — fingerprints); r21d's transform has been
+    # fully device-fused since its port (the flag is a documented no-op
+    # there). The bench `device_preproc` scenario records the decode-seconds
+    # vs host→device-bytes trade; parity pins live in
+    # tests/test_device_preproc.py.
+    device_preproc: bool = False
     # Dense-flow D2H transfer dtype (raft/pwc extractors): the device casts
     # the flow before the host fetch and the host upcasts back to fp32 (.npy
     # outputs stay fp32). "float16" halves the fetched bytes at ≤0.01 px

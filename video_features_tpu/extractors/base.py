@@ -91,6 +91,12 @@ class Extractor(abc.ABC):
     # bit-parity host resize
     supports_device_resize = False
 
+    # True for models with a --device_preproc path (the remaining host-side
+    # preprocess — edge resize, /8 pad, log-mel — runs as a fused jitted
+    # prologue and the host ships raw decoded data); models without one
+    # print a notice and keep their host preprocess
+    supports_device_preproc = False
+
     def __init__(self, cfg: ExtractionConfig):
         cfg = resolve_model_defaults(cfg)
         cfg.validate()
@@ -143,8 +149,13 @@ class Extractor(abc.ABC):
                              on_wait=self._transfer_wait))
         if cfg.device_resize and not type(self).supports_device_resize:
             print(f"--device_resize ignored: {cfg.feature_type} has no "
-                  "device-side resize path (resnet50 only); keeping the "
+                  "device-side resize path (use --device_preproc for the "
+                  "every-model device preprocessing surface); keeping the "
                   "host PIL resize")
+        if cfg.device_preproc and not type(self).supports_device_preproc:
+            print(f"--device_preproc ignored: {cfg.feature_type} has no "
+                  "device-side preprocessing path; keeping the host "
+                  "preprocess")
         # async output writer; created by run() for save_numpy jobs unless
         # --sync_writer opted out. _pending_writes holds (path, WriteHandle)
         # for extractions whose output is still on the writer thread — on
@@ -931,6 +942,12 @@ class Extractor(abc.ABC):
             "pages_dispatched": packer.pages_dispatched,
             "max_in_flight": packer.max_in_flight,
         }
+        if self.clock is not None:
+            # per-stage wall seconds for the whole corpus (metrics runs only)
+            # — the bench's device_preproc scenario reads the decode stage
+            # from here to show decode-pool relief with the flag on
+            self._pack_stats["stage_seconds"] = {
+                k: round(v, 4) for k, v in self.clock.seconds.items()}
         if with_metrics:
             dt = time.perf_counter() - t_run
             if self.clock is not None:

@@ -27,6 +27,16 @@ pipeline double-buffers the fetch (``copy_to_host_async`` + a bounded pending
 queue, so transfer overlaps both compute and decode) and
 ``--transfer_dtype float16`` halves the bytes on the wire (cast on device,
 upcast on host; outputs stay fp32 ``.npy``).
+
+``--device_preproc`` moves the last host-side preprocess — the /8 (RAFT) or
+``--shape_bucket`` replicate pad — inside the jitted step
+(``models/raft.device_pad_to_shape``): windows stage and ride the wire at RAW
+decoded geometry and the pad runs on the uint8 wire as the step's first fused
+op. Replication on integers is arithmetic-free, so outputs stay BYTE-identical
+to the host pad (pinned in tests/test_device_preproc.py) — the flag is
+execution-only for flow in cache/key.py. Each pad target memoizes its own
+jitted step (``_frames_step_for``) so a raw geometry can never reuse a program
+traced for a different bucket.
 """
 
 from __future__ import annotations
@@ -40,6 +50,8 @@ from typing import Dict, List
 import numpy as np
 
 from ..models.raft import (
+    device_pad_to_shape,
+    pad_split,
     pad_to_multiple,
     pad_to_shape,
     pad_to_shape_into,
@@ -59,6 +71,11 @@ class ExtractFlow(Extractor):
     """feature_type 'raft' or 'pwc'; emits dense flow frames, not embeddings."""
 
     uses_frame_stream = True
+    # --device_preproc: the geometry pad moves inside the jitted step (raw
+    # decoded frames on the wire; device_pad_to_shape is byte-exact vs the
+    # host pad). The optional --side_size edge resize stays host PIL — it is
+    # a parity-bearing reference transform, not padding.
+    supports_device_preproc = True
 
     def __init__(self, cfg):
         super().__init__(cfg)
@@ -77,6 +94,15 @@ class ExtractFlow(Extractor):
         # --pack_corpus: corpus bucket plan (PackSpec.prepare fills it from
         # the container probes before the packed loop starts)
         self._pack_buckets = None
+        # --device_preproc: pad-on-device steps, one memoized jitted step per
+        # (sharded?, pad target) — jit caches per INPUT shape, so a single
+        # step closing over a mutable target could silently reuse a program
+        # traced for a different bucket on a repeat raw geometry
+        # (vftlint GUARDED_BY: _frames_steps under the 'flow-steps' lock —
+        # precompile warmup threads race the run loop on first-build)
+        self._device_preproc = cfg.device_preproc
+        self._frames_steps: dict = {}
+        self._frames_steps_lock = threading.Lock()
         flow_dtype = jnp.bfloat16 if cfg.flow_dtype == "bfloat16" else jnp.float32
         # D2H transfer dtype: the jitted steps cast their output to this on
         # device; the host upcasts back to fp32. float16 halves the fetched
@@ -189,12 +215,56 @@ class ExtractFlow(Extractor):
 
         return self.runner.jit(step, n_batch_args=1, n_replicated_args=1)
 
+    def _frames_step_for(self, target_hw, sharded: bool):
+        """--device_preproc step for one pad target: raw-geometry frames in,
+        ``device_pad_to_shape`` to ``target_hw`` as the first fused op (on the
+        wire dtype — replicate-pad on uint8 is byte-exact), then the same
+        encode-once forward as :attr:`_frames_step` /
+        :attr:`_frames_step_sharded`.
+
+        One memoized jitted step PER (sharded?, target): jit caches programs
+        by input shape, so a single step closing over a mutable target would
+        silently reuse the program traced for a different bucket whenever the
+        same raw geometry reappears under a new bucket plan.
+        """
+        key = (bool(sharded), int(target_hw[0]), int(target_hw[1]))
+        with self._frames_steps_lock:
+            step = self._frames_steps.get(key)
+            if step is None:
+                tdt = self._transfer_dtype
+                th, tw = key[1], key[2]
+                if sharded:
+                    fwd = self._forward_frames_sharded
+
+                    def step(params, frames, frame_last):
+                        # pad is per-frame (trailing H/W axes), so it shards
+                        # trivially along the frame axis
+                        return fwd(params,
+                                   device_pad_to_shape(frames, (th, tw)),
+                                   device_pad_to_shape(frame_last, (th, tw))
+                                   ).astype(tdt)
+
+                    step = self.runner.jit(step, n_batch_args=1,
+                                           n_replicated_args=1)
+                else:
+                    fwd = self._forward_frames
+
+                    def step(params, frames):
+                        return fwd(params, device_pad_to_shape(
+                            frames, (th, tw))).astype(tdt)
+
+                    step = self.runner.jit(step)
+                self._frames_steps[key] = step
+        return step
+
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
         return pil_edge_resize(rgb, self.cfg.side_size, self.cfg.resize_to_smaller_edge)
 
     def _device_call(self, frames: np.ndarray, staged: np.ndarray = None,
-                     timed: bool = True):
-        """Dispatch one PADDED (batch_size+1)-frame window to the jitted step.
+                     timed: bool = True, pad_target=None):
+        """Dispatch one (batch_size+1)-frame window to the jitted step —
+        PADDED frames by default; RAW-geometry frames with ``pad_target``
+        set (--device_preproc), where the per-target step pads on device.
 
         Single-device meshes run the shared-frame step whole; multi-device
         meshes shard the B source frames on the frame axis and replicate the
@@ -214,12 +284,16 @@ class ExtractFlow(Extractor):
             dev = put(np.ascontiguousarray(frames))
             if staged is not None:
                 self._staging.commit(staged, dev)
-            return self._frames_step(self.params, dev)
+            step = (self._frames_step if pad_target is None
+                    else self._frames_step_for(pad_target, sharded=False))
+            return step(self.params, dev)
         main = put(np.ascontiguousarray(frames[:-1]))
         last = put_rep(np.ascontiguousarray(frames[-1:]))
         if staged is not None:
             self._staging.commit(staged, (main, last))
-        return self._frames_step_sharded(self.params, main, last)
+        step = (self._frames_step_sharded if pad_target is None
+                else self._frames_step_for(pad_target, sharded=True))
+        return step(self.params, main, last)
 
     def _window_geometry(self, h: int, w: int):
         """Padded (TH, TW) a decoded ``h``×``w`` frame dispatches at — the
@@ -227,6 +301,16 @@ class ExtractFlow(Extractor):
         shared by the staging-ring window assembly."""
         m = self.cfg.shape_bucket or (8 if self._pads_input else 1)
         return -(-h // m) * m, -(-w // m) * m
+
+    def _window_pad_target(self, h: int, w: int):
+        """--device_preproc pad target for a RAW decoded ``h``×``w`` frame:
+        the per-video padded geometry, widened to its corpus bucket when a
+        packed run's bucket plan is live — the same (TH, TW) the host pad
+        would have staged, now applied on device."""
+        geom = self._window_geometry(h, w)
+        if self._pack_buckets is not None:
+            geom = self._pack_buckets.bucket_for(geom)
+        return geom
 
     def _dispatch_window(self, window):
         """Stage one decoded frame window into a reusable staging-ring buffer
@@ -242,6 +326,24 @@ class ExtractFlow(Extractor):
         """
         n_pairs = len(window) - 1
         h, w = window[0].shape[:2]
+        if self._device_preproc:
+            # raw-pixels wire: the ring buffer keys by the DECODED geometry
+            # (no host pad — plain frame copies) and the per-target jitted
+            # step replicate-pads on device, byte-exact on the uint8 wire;
+            # the host keeps only the pad arithmetic for the final unpad
+            th, tw = self._window_pad_target(h, w)
+            buf = self._staging.acquire((self.batch_size + 1, h, w, 3),
+                                        self._wire)
+            for i, frame in enumerate(window):
+                buf[i] = frame
+            for i in range(len(window), self.batch_size + 1):
+                buf[i] = buf[len(window) - 1]  # static shape: repeat the tail
+            pads = pad_split(h, w, th, tw)
+            if not (self.cfg.shape_bucket or self._pads_input):
+                pads = None  # PWC-at-native parity: no unpad slicing
+            flow = self._device_call(buf, staged=buf, pad_target=(th, tw))
+            self._start_async_copy(flow)
+            return flow, n_pairs, pads
         th, tw = self._window_geometry(h, w)
         buf = self._staging.acquire((self.batch_size + 1, th, tw, 3),
                                     self._wire)
@@ -324,17 +426,23 @@ class ExtractFlow(Extractor):
 
     # --- geometry precompile (--precompile) --------------------------------
 
-    def _padded_geometry(self, width: int, height: int):
-        """(H, W) of the padded device window a native ``width``×``height``
-        video will dispatch: the host edge-resize sizing followed by the
-        shape_bucket (or RAFT /8) padding — the same arithmetic
-        ``_host_transform`` + ``_dispatch_pairs`` apply per frame."""
+    def _decoded_geometry(self, width: int, height: int):
+        """(H, W) of a decoded frame after ``_host_transform`` — the RAW
+        geometry ``--device_preproc`` windows stage and ship at — from the
+        container probe's native ``width``×``height``."""
         if self.cfg.side_size is not None:
             w, h = edge_resize_size(width, height, self.cfg.side_size,
                                     self.cfg.resize_to_smaller_edge)
         else:
             w, h = width, height
-        return self._window_geometry(h, w)
+        return h, w
+
+    def _padded_geometry(self, width: int, height: int):
+        """(H, W) of the padded device window a native ``width``×``height``
+        video will dispatch: the host edge-resize sizing followed by the
+        shape_bucket (or RAFT /8) padding — the same arithmetic
+        ``_host_transform`` + ``_dispatch_pairs`` apply per frame."""
+        return self._window_geometry(*self._decoded_geometry(width, height))
 
     def _start_precompile(self, width: int, height: int) -> None:
         """Warm the jitted step for this video's geometry while decode runs.
@@ -349,17 +457,26 @@ class ExtractFlow(Extractor):
         starting its own. One wasted zeros execution per NEW geometry; repeat
         geometries return immediately.
         """
-        self._start_precompile_padded(self._padded_geometry(width, height))
+        self._start_precompile_padded(
+            self._padded_geometry(width, height),
+            raw_hw=(self._decoded_geometry(width, height)
+                    if self._device_preproc else None))
 
-    def _start_precompile_padded(self, padded_hw) -> None:
+    def _start_precompile_padded(self, padded_hw, raw_hw=None) -> None:
         """Warm the device program for an already-padded (H, W) geometry —
         the packed loop warms each video's *bucket* geometry (the program the
-        packed windows actually dispatch) rather than its own padding."""
+        packed windows actually dispatch) rather than its own padding.
+
+        ``raw_hw`` (--device_preproc): the decoded geometry real windows
+        stage at; the warmed program is then the per-pad-target step over
+        raw-geometry input — warming the padded-input program would warm one
+        no dispatch ever runs."""
         h, w = padded_hw
+        key = (h, w) if raw_hw is None else (h, w) + tuple(raw_hw)
         with self._precompile_lock:
-            if (h, w) in self._precompiled:
+            if key in self._precompiled:
                 return
-            self._precompiled.add((h, w))
+            self._precompiled.add(key)
 
         def warm():
             try:
@@ -367,9 +484,18 @@ class ExtractFlow(Extractor):
 
                 # wire dtype (uint8 unless --float32_wire): the warmed
                 # program must be the one the real dispatch uses
-                window = np.zeros((self.batch_size + 1, h, w, 3), self._wire)
+                if raw_hw is not None:
+                    window = np.zeros(
+                        (self.batch_size + 1,) + tuple(raw_hw) + (3,),
+                        self._wire)
+                    handle = self._device_call(window, timed=False,
+                                               pad_target=(h, w))
+                else:
+                    window = np.zeros((self.batch_size + 1, h, w, 3),
+                                      self._wire)
+                    handle = self._device_call(window, timed=False)
                 # host-sync: warmup thread blocks on the zeros window off the critical path by design
-                jax.block_until_ready(self._device_call(window, timed=False))
+                jax.block_until_ready(handle)
             except Exception as e:  # noqa: BLE001 — fault-barrier: best-effort warmup; the real dispatch compiles inline and surfaces any genuine error
                 print(f"[flow] geometry precompile ({h}x{w}) failed: "
                       f"{type(e).__name__}: {e}; the first window will "
@@ -386,7 +512,12 @@ class ExtractFlow(Extractor):
         ``open_clips`` yields ``(2, Hb, Wb, 3)`` uint8 pairs already padded to
         the video's bucket geometry (``ShapeBuckets`` over the corpus's
         container probes — ≤ ``--pack_buckets`` compiled programs for a
-        mixed-resolution corpus). ``collate`` chains stream-consecutive pairs
+        mixed-resolution corpus) — or RAW ``(2, H, W, 3)`` decoded pairs
+        under ``--device_preproc``, where queues key per decoded geometry
+        and the per-pad-target step replicate-pads on device (byte-exact on
+        the uint8 wire; the bucket plan still bounds compiled programs
+        because the pad target is bucketed). ``collate`` chains
+        stream-consecutive pairs
         back into one ``(batch_size + 1)``-frame shared-frame window — the
         same encode-once program :meth:`_device_call` runs in the per-video
         loop (frame-sharded with halo exchange on multi-device meshes) — so
@@ -420,8 +551,10 @@ class ExtractFlow(Extractor):
             geom = self._padded_geometry(meta.width, meta.height)
             bucket = (self._pack_buckets.bucket_for(geom)
                       if self._pack_buckets is not None else geom)
+            raw_hw = (self._decoded_geometry(meta.width, meta.height)
+                      if self._device_preproc else None)
             if self.cfg.precompile:
-                self._start_precompile_padded(bucket)
+                self._start_precompile_padded(bucket, raw_hw=raw_hw)
             info = {
                 "fps": meta.fps,
                 "timestamps_ms": [],
@@ -430,15 +563,20 @@ class ExtractFlow(Extractor):
                 "native_hw": (meta.height, meta.width),
                 "pads": (0, 0, 0, 0),
             }
+            if raw_hw is not None:
+                # raw wire: the step pads on device; the host keeps only the
+                # pad arithmetic so finalize can unpad the fetched flow
+                info["pads"] = pad_split(raw_hw[0], raw_hw[1], *bucket)
 
             def clips():
                 prev = None
                 for rgb, pos in self._timed_frames(frames):
                     info["timestamps_ms"].append(pos)
-                    frame, info["pads"] = pad_to_shape(rgb, bucket)
+                    if raw_hw is None:
+                        rgb, info["pads"] = pad_to_shape(rgb, bucket)
                     if prev is not None:
-                        yield np.stack([prev, frame])
-                    prev = frame
+                        yield np.stack([prev, rgb])
+                    prev = rgb
 
             return info, clips()
 
@@ -473,7 +611,14 @@ class ExtractFlow(Extractor):
             return buf, n_used, row_of
 
         def step(window):
-            out = self._device_call(window, staged=window)
+            # --device_preproc windows arrive at RAW decoded geometry (one
+            # queue per geometry, so a window never mixes shapes); the pad
+            # target is the bucket that geometry maps to — the same pure
+            # function of (h, w) open_clips used for info["pads"]
+            pad_target = (self._window_pad_target(*window.shape[1:3])
+                          if self._device_preproc else None)
+            out = self._device_call(window, staged=window,
+                                    pad_target=pad_target)
             # same overlap as the per-video loop's _dispatch_window: the
             # packer fetches this batch only when the bucket's NEXT batch
             # dispatches, so the transfer races compute, not the fetch

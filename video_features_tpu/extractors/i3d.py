@@ -45,7 +45,7 @@ from ..models.raft import (
     raft_forward_frames_sharded,
     raft_init_params,
 )
-from ..ops.image import pil_edge_resize
+from ..ops.image import device_edge_resize_hwc, pil_edge_resize
 from ..parallel import prefetch_to_device
 from ..utils.labels import show_predictions_on_dataset
 from ..weights.convert_torch import convert_i3d, convert_pwc, convert_raft
@@ -66,10 +66,17 @@ def _center_crop_nhwc(x: jnp.ndarray, size: int) -> jnp.ndarray:
 
 class ExtractI3D(Extractor):
     uses_frame_stream = True
+    # --device_preproc: the host PIL 256-edge resize moves inside every
+    # jitted stream body (ops/image.device_edge_resize_hwc over the whole
+    # clip stack, BEFORE the /8 pad and 224 crop, which already run on
+    # device) — raw decoded stacks ride the wire, queues key per decoded
+    # geometry, tolerance-gated vs the PIL path (tests/test_device_preproc.py)
+    supports_device_preproc = True
 
     def __init__(self, cfg):
         super().__init__(cfg)
         cfg = self.cfg  # model defaults resolved by the base class
+        self._device_preproc = cfg.device_preproc
         self.streams = tuple(cfg.streams or ("rgb", "flow"))
         self.stack_size = cfg.stack_size
         self.step_size = cfg.step_size
@@ -160,6 +167,10 @@ class ExtractI3D(Extractor):
         # pure per-row stream body — jitted whole by `_rgb_step`, composed
         # (un-jitted) into the paged program by `pack_spec`
         model = self.i3d["rgb"]
+        if self._device_preproc:
+            # raw decoded stack in: the 256-edge resize runs fused here
+            # (float32 [0,255] out; preprocess casts anyway)
+            stacks_u8 = device_edge_resize_hwc(stacks_u8, self.pre_crop_size)
         x = i3d_preprocess_rgb(
             _center_crop_nhwc(stacks_u8[:, :-1], self.crop_size),
             dtype=self.dtype
@@ -181,6 +192,11 @@ class ExtractI3D(Extractor):
         model = self.i3d["flow"]
         flow_dtype = (jnp.bfloat16 if self.cfg.flow_dtype == "bfloat16"
                       else jnp.float32)
+        if self._device_preproc:
+            # raw decoded stack in: resize BEFORE the shape unpack so the
+            # /8 pad and the flow nets see post-resize geometry (the flow is
+            # computed on the resized pre-crop stack, as on the host path)
+            stacks_u8 = device_edge_resize_hwc(stacks_u8, self.pre_crop_size)
         n, sp1, h, w, _c = stacks_u8.shape
         frames = stacks_u8.astype(jnp.float32)
         # shared-frame flow: each frame is encoded ONCE and the N·S
@@ -248,11 +264,18 @@ class ExtractI3D(Extractor):
         pwc_corr = self.cfg.pwc_corr
         pwc_warp = self.cfg.pwc_warp
         crop = self.crop_size
+        pre_crop = self.pre_crop_size
+        device_preproc = self._device_preproc
         mesh = self.runner.mesh
 
         def step(params, frames_u8, last_u8):
             # frames_u8: (S, H, W, 3) uint8 sharded on the frame axis;
             # last_u8: (1, H, W, 3) replicated — together one (S+1)-frame stack
+            if device_preproc:
+                # raw decoded frames in: per-frame resize shards trivially
+                # along the frame axis (no cross-frame support)
+                frames_u8 = device_edge_resize_hwc(frames_u8, pre_crop)
+                last_u8 = device_edge_resize_hwc(last_u8, pre_crop)
             s, h, w, _c = frames_u8.shape
             frames = frames_u8.astype(jnp.float32)
             last = last_u8.astype(jnp.float32)
@@ -286,11 +309,15 @@ class ExtractI3D(Extractor):
     # --- pipeline -----------------------------------------------------------
 
     def _host_transform(self, rgb: np.ndarray) -> np.ndarray:
+        if self._device_preproc:
+            return rgb  # ship the raw decoded frame; the stream bodies resize
         return pil_edge_resize(rgb, self.pre_crop_size)
 
     def pack_spec(self):
         """Corpus-packing seam for every stream mix: slots are
-        ``(stack_size + 1, H, W, 3)`` resized stacks, shape-keyed per decoded
+        ``(stack_size + 1, H, W, 3)`` resized stacks — or RAW decoded stacks
+        under ``--device_preproc``, where the resize runs inside the stream
+        bodies — shape-keyed per decoded
         geometry (the 256-edge resize keys queues by aspect ratio; the
         bucket-planning flow extractors bound geometry counts — here distinct
         aspect ratios simply fill distinct queues and the anti-starvation

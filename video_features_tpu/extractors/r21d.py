@@ -36,6 +36,13 @@ from .base import Extractor
 
 
 class ExtractR21D(Extractor):
+    # --device_preproc is a documented no-op here: r21d's whole transform
+    # chain (/255 → bilinear resize (128, 171) → Kinetics normalize → center
+    # crop 112, r21d_preprocess) has run device-fused since the port — raw
+    # native-resolution clips are ALREADY the wire format, so the general
+    # flag has nothing left to move and must not print the "ignored" notice
+    supports_device_preproc = True
+
     def __init__(self, cfg):
         super().__init__(cfg)
         cfg = self.cfg  # model defaults resolved by the base class

@@ -9,10 +9,11 @@ head (``:54-58,98-101``).
 
 TPU design: host does decode+resize+crop (uint8); the jitted device step fuses
 normalize into the conv stack; the tail batch is zero-padded to the static batch
-shape so XLA compiles exactly one program per run. ``--device_resize`` moves the
-PIL resize+crop inside the step too (``ops/image.device_resize_crop_hwc``): raw
-decoded frames ride the wire, one compiled program per decoded geometry, at a
-documented tolerance vs the PIL parity path (docs/performance.md).
+shape so XLA compiles exactly one program per run. ``--device_resize`` (or its
+every-model generalization ``--device_preproc``) moves the PIL resize+crop
+inside the step too (``ops/image.device_resize_crop_hwc``): raw decoded frames
+ride the wire, one compiled program per decoded geometry, at a documented
+tolerance vs the PIL parity path (docs/performance.md).
 """
 
 from __future__ import annotations
@@ -43,10 +44,14 @@ class ExtractResNet50(Extractor):
     # slots keyed per decoded geometry in packed runs; tolerance-gated vs
     # the bit-parity host path (docs/performance.md)
     supports_device_resize = True
+    # --device_preproc is the same path here: resnet50's only host preprocess
+    # IS the resize+crop, so the general flag folds into _device_resize
+    # (cache/key.py resolves the two flags identically for resnet50)
+    supports_device_preproc = True
 
     def __init__(self, cfg):
         super().__init__(cfg)
-        self._device_resize = cfg.device_resize
+        self._device_resize = cfg.device_resize or cfg.device_preproc
         # round the user batch up to a multiple of the mesh size so the sharded
         # leading axis always divides evenly (tail rows are zero-padded + trimmed)
         self.batch_size = self.runner.device_batch(cfg.batch_size)
@@ -92,23 +97,28 @@ class ExtractResNet50(Extractor):
         return np_center_crop_hwc(rgb, CENTER_CROP_SIZE, CENTER_CROP_SIZE)
 
     def pack_spec(self):
-        """Corpus-packing seam: every device slot is one 224² frame, so the
-        whole corpus shares a single shape queue and the tail batch of video
-        N fills with the head of video N+1. Per-row features are byte-
-        identical to the per-video loop: the conv stack has no cross-sample
-        ops and packed batches run the SAME jitted program (same static batch
-        shape as the zero-padded per-video batches)."""
+        """Corpus-packing seam: every device slot is one 224² frame — or one
+        RAW decoded frame under ``--device_resize``/``--device_preproc``,
+        where queues key by decoded geometry — so same-shape clips share a
+        queue and the tail batch of video N fills with the head of video
+        N+1. Per-row features are byte-identical to the per-video loop on
+        the 224² wire (no cross-sample ops, same jitted program); the raw
+        wire is ulp-level instead — pages run the resize prologue at
+        page_rows, a different static shape than the per-video batch, and
+        XLA's f32 resize is not bitwise-stable across shapes
+        (tests/test_device_preproc.py pins 1e-5 relative)."""
         if self.cfg.show_pred:
             return None  # debug path prints per-batch top-5 in video order
         from ..parallel.packer import PackSpec
 
-        # Ragged paged dispatch (--paged_batching): the 224² fixed wire
-        # format qualifies; --device_resize opts out per model — its wire
-        # geometry varies per decoded video, so pages cannot co-host
-        # different sources under one compiled program.
-        paged = ({} if self._device_resize
-                 else self._paged_fields(self._forward, self.params,
-                                         self.batch_size))
+        # Ragged paged dispatch (--paged_batching): always on. Packer queues
+        # are keyed by clip shape, so under --device_resize/--device_preproc
+        # each raw decoded geometry pages through its OWN queue — pages never
+        # co-host mixed geometries, and every queue shares one compiled
+        # jit_paged family per geometry (the same multi-queue paging i3d's
+        # aspect-ratio buckets already exercise).
+        paged = self._paged_fields(self._forward, self.params,
+                                   self.batch_size)
 
         def open_clips(path):
             meta, frames = self._open_video(path)

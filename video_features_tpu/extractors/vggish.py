@@ -12,7 +12,14 @@ Behavioral spec — ``/root/reference/models/vggish/extract_vggish.py``:
 - output dict: ``{'vggish': (N, 128)}`` (no fps/timestamps — audio model).
 
 TPU design: examples are padded to a static batch so each audio length bucket
-compiles once; the forward runs jitted on device.
+compiles once; the forward runs jitted on device. ``--device_preproc`` moves
+the log-mel DSP itself on device: the host ships raw (N, 15600) float32 PCM
+slabs (``melspec.wav_to_pcm_slabs``) and the jitted step runs the fused
+framing → |rfft| → mel matmul → log prologue
+(:func:`video_features_tpu.ops.audio.log_mel_examples`, ≤2e-5 vs the numpy
+oracle) before the VGG stack. The wire grows 6144→15600 floats per example
+(raw PCM is bigger than its mel summary) — the trade is host-CPU relief: the
+strided-FFT DSP leaves the decode pool for the accelerator.
 """
 
 from __future__ import annotations
@@ -25,8 +32,9 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from ..audio.melspec import wav_to_examples
+from ..audio.melspec import wav_to_examples, wav_to_pcm_slabs
 from ..io import ffmpeg as ffmpeg_io
+from ..ops.audio import log_mel_examples
 from ..models.vggish import (
     EMBEDDING_SIZE,
     Postprocessor,
@@ -42,8 +50,14 @@ EXAMPLE_BATCH = 32
 
 
 class ExtractVGGish(Extractor):
+    # --device_preproc: the log-mel DSP runs as a fused jitted prologue
+    # (ops/audio.log_mel_examples) over raw PCM slabs; host melspec stays the
+    # parity oracle (≤2e-5, tests/test_device_preproc.py)
+    supports_device_preproc = True
+
     def __init__(self, cfg):
         super().__init__(cfg)
+        self._device_preproc = cfg.device_preproc
         # examples per device step, rounded to a multiple of the mesh size
         self.example_batch = self.runner.device_batch(EXAMPLE_BATCH)
         self.model = VGGish()
@@ -73,6 +87,16 @@ class ExtractVGGish(Extractor):
     def _step(self):
         return self.runner.jit(self._forward)
 
+    def _pcm_forward(self, params, pcm):
+        # (B, 15600) float32 raw PCM; pure per-row — the log-mel prologue
+        # fuses into the VGG stack, and the paged dispatch path wraps this
+        # same body (parallel/pages.paged_program)
+        return self.model.apply({"params": params}, log_mel_examples(pcm))
+
+    @functools.cached_property
+    def _pcm_step(self):
+        return self.runner.jit(self._pcm_forward)
+
     def pack_spec(self):
         """Corpus-packing seam: every device slot is one fixed ``(96, 64)``
         log-mel example, so the whole corpus shares a single shape queue —
@@ -94,9 +118,15 @@ class ExtractVGGish(Extractor):
                     path, self.tmp_dir)
                 extracted = True
 
+            # --device_preproc slots are (15600,) raw PCM slabs (the log-mel
+            # runs in the step); default slots are (96, 64) host examples —
+            # both fixed shapes, so either way one corpus-wide shape queue
+            to_rows = (wav_to_pcm_slabs if self._device_preproc
+                       else wav_to_examples)
+
             def clips():
                 try:
-                    for example in wav_to_examples(wav_path):  # (96, 64) each
+                    for example in to_rows(wav_path):
                         yield example
                 finally:
                     # generator close/exhaustion = the per-video loop's
@@ -108,20 +138,24 @@ class ExtractVGGish(Extractor):
 
             return {}, clips()
 
+        batch_step = self._pcm_step if self._device_preproc else self._step
+
         def step(examples):
             # _put: 'transfer'-stage attribution (time + staged bytes); the
             # packer commits the staged ring buffer after the step
-            return self._step(self.params, self._put(examples))
+            return batch_step(self.params, self._put(examples))
 
         def finalize(path, rows, info):
             if self.postprocessor is not None:
                 rows = self.postprocessor.postprocess(rows)
             return {self.feature_type: rows}
 
+        forward = (self._pcm_forward if self._device_preproc
+                   else self._forward)
         return PackSpec(batch_size=self.example_batch,
                         empty_row_shape=(EMBEDDING_SIZE,),
                         open_clips=open_clips, step=step, finalize=finalize,
-                        **self._paged_fields(self._forward, self.params,
+                        **self._paged_fields(forward, self.params,
                                              self.example_batch))
 
     def extract(self, video_path: str) -> Dict[str, np.ndarray]:
@@ -132,14 +166,19 @@ class ExtractVGGish(Extractor):
             wav_path, aac_path = ffmpeg_io.extract_wav_from_mp4(video_path, self.tmp_dir)
             extracted = True
         try:
-            examples = wav_to_examples(wav_path)  # (N, 96, 64)
+            if self._device_preproc:  # (N, 15600) raw PCM; log-mel in-step
+                examples = wav_to_pcm_slabs(wav_path)
+                step = self._pcm_step
+            else:
+                examples = wav_to_examples(wav_path)  # (N, 96, 64)
+                step = self._step
             feats = []
             for i in range(0, len(examples), self.example_batch):
                 chunk = examples[i : i + self.example_batch]
                 valid = len(chunk)
                 batch = self._put(pad_batch(chunk, self.example_batch))
                 # stays on device; one host fetch per video
-                feats.append(self._step(self.params, batch)[:valid])
+                feats.append(step(self.params, batch)[:valid])
                 self._throttle(feats)
             out = (
                 self._wait(jnp.concatenate(feats, axis=0))

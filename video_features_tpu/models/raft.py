@@ -649,6 +649,38 @@ def pad_to_multiple_of_8(frames: np.ndarray) -> Tuple[np.ndarray, Tuple[int, int
     return pad_to_multiple(frames, 8)
 
 
+def pad_split(h: int, w: int, th: int, tw: int,
+              ) -> Tuple[int, int, int, int]:
+    """The centered sintel pad split (top, bottom, left, right) taking
+    ``h``×``w`` frames to ``th``×``tw`` — the one arithmetic every pad
+    variant here (host, in-place, traced) and the unpad slicing share."""
+    if th < h or tw < w:
+        raise ValueError(f"cannot pad {h}x{w} frames down to bucket {th}x{tw}")
+    ph, pw = th - h, tw - w
+    return ph // 2, ph - ph // 2, pw // 2, pw - pw // 2
+
+
+def device_pad_to_shape(x: jnp.ndarray, target_hw: Tuple[int, int],
+                        ) -> jnp.ndarray:
+    """Traced :func:`pad_to_shape`: replicate-pad (…, H, W, C) to an
+    explicit ``(H, W)`` geometry INSIDE the jitted step (``--device_preproc``
+    — the host ships RAW decoded frames and the /8-or-bucket pad becomes the
+    step's first fused op). Geometry is static at trace time; the same
+    centered sintel split as the host pad, on the same wire dtype
+    (``jnp.pad(mode="edge")`` replicates values without arithmetic), so the
+    padded window is BYTE-identical to ``pad_to_shape`` — pinned by
+    tests/test_device_preproc.py, which is why the flag is execution-only
+    for the flow extractors (cache/key.py).
+    """
+    th, tw = target_hw
+    h, w = int(x.shape[-3]), int(x.shape[-2])
+    top, bottom, left, right = pad_split(h, w, th, tw)
+    if not (top or bottom or left or right):
+        return x
+    pad = [(0, 0)] * (x.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
+    return jnp.pad(x, pad, mode="edge")
+
+
 def pad_to_shape(frames: np.ndarray, target_hw: Tuple[int, int],
                  ) -> Tuple[np.ndarray, Tuple[int, int, int, int]]:
     """Replicate-pad (…, H, W, C) to an explicit ``(H, W)`` bucket geometry.
@@ -662,13 +694,9 @@ def pad_to_shape(frames: np.ndarray, target_hw: Tuple[int, int],
     """
     th, tw = target_hw
     h, w = frames.shape[-3:-1]
-    if th < h or tw < w:
-        raise ValueError(f"cannot pad {h}x{w} frames down to bucket {th}x{tw}")
-    ph, pw = th - h, tw - w
-    if not (ph or pw):
+    top, bottom, left, right = pad_split(h, w, th, tw)
+    if not (top or bottom or left or right):
         return frames, (0, 0, 0, 0)
-    top, bottom = ph // 2, ph - ph // 2
-    left, right = pw // 2, pw - pw // 2
     pad = [(0, 0)] * (frames.ndim - 3) + [(top, bottom), (left, right), (0, 0)]
     return np.pad(frames, pad, mode="edge"), (top, bottom, left, right)
 
@@ -689,11 +717,7 @@ def pad_to_shape_into(frame: np.ndarray, out: np.ndarray,
     """
     th, tw = out.shape[0], out.shape[1]
     h, w = frame.shape[0], frame.shape[1]
-    if th < h or tw < w:
-        raise ValueError(f"cannot pad {h}x{w} frames down to bucket {th}x{tw}")
-    ph, pw = th - h, tw - w
-    top, bottom = ph // 2, ph - ph // 2
-    left, right = pw // 2, pw - pw // 2
+    top, bottom, left, right = pad_split(h, w, th, tw)
     out[top : th - bottom, left : tw - right] = frame
     if left:
         out[top : th - bottom, :left] = frame[:, :1]

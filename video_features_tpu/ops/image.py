@@ -7,10 +7,13 @@ support, so for bit-parity the aspect-preserving edge resize stays on the host (
 uint8 is exactly what the reference computes); everything after — center crop, scaling
 to [-1,1], flow quantization — is pure elementwise math and runs on device inside the
 jitted forward (:mod:`video_features_tpu.extractors`), where XLA fuses it into the
-first conv. ``--device_resize`` (resnet50) opts the edge resize itself onto the
-device too (:func:`device_resize_crop_hwc`) — raw decoded frames on the wire, the
-whole preprocess fused into the step — trading that bit-parity contract for ingest
-throughput at a tolerance pinned in tests/test_ingest.py.
+first conv. ``--device_resize`` (resnet50) and ``--device_preproc`` (its
+every-model generalization — resnet50 frames and i3d clip stacks alike) opt the
+edge resize itself onto the device too (:func:`device_resize_crop_hwc` /
+:func:`device_edge_resize_hwc`) — raw decoded frames on the wire, the whole
+preprocess fused into the step — trading that bit-parity contract for ingest
+throughput at a tolerance pinned in tests/test_ingest.py and
+tests/test_device_preproc.py.
 """
 
 from __future__ import annotations
@@ -58,21 +61,25 @@ def pil_edge_resize(
     return np.asarray(Image.fromarray(rgb_hwc).resize((ow, oh), Image.BILINEAR))
 
 
-def device_resize_crop_hwc(x: jnp.ndarray, size: int, crop: int,
+def device_edge_resize_hwc(x: jnp.ndarray, size: int,
                            to_smaller_edge: bool = True) -> jnp.ndarray:
-    """Traced edge resize + round-half center crop for NHWC frames — the
-    ``--device_resize`` fast path (docs/performance.md "ingest fast path").
+    """Traced aspect-preserving edge resize for (..., H, W, C) frames — the
+    crop-free core of the device-side preprocessing fast path
+    (docs/performance.md "ingest fast path").
 
-    The host ships RAW decoded uint8 frames and this runs INSIDE the jitted
-    step: ``jax.image.resize`` bilinear (antialiased on downscale) to the
-    same target the reference's PIL resize computes (``edge_resize_size``
-    arithmetic, static at trace time), then the torchvision round-half
-    center crop. NOT bit-identical to :func:`pil_edge_resize` — PIL
-    interpolates in uint8 with its own filter support and rounding, XLA in
-    float — which is exactly why the module contract above keeps the host
-    path as the parity default; the drift is tolerance-pinned in
-    tests/test_ingest.py and documented in docs/performance.md. Returns
-    float32 frames in [0, 255] (N, crop, crop, C).
+    The host ships RAW decoded uint8 frames (single frames or whole clip
+    stacks — any leading dims) and this runs INSIDE the jitted step:
+    ``jax.image.resize`` bilinear (antialiased on downscale) to the same
+    target the reference's PIL resize computes (``edge_resize_size``
+    arithmetic, static at trace time). NOT bit-identical to
+    :func:`pil_edge_resize` — PIL interpolates in uint8 with its own filter
+    support and rounding, XLA in float — which is exactly why the module
+    contract above keeps the host path as the parity default; the drift is
+    tolerance-pinned in tests/test_ingest.py and tests/test_device_preproc.py.
+    Exposed crop-free because the i3d flow stream computes flow on the
+    RESIZED (pre-crop) stack and crops only after the flow net — the crop
+    cannot be fused into the resize there. Returns float32 frames in
+    [0, 255] at the resized geometry.
     """
     import jax
 
@@ -82,6 +89,17 @@ def device_resize_crop_hwc(x: jnp.ndarray, size: int, crop: int,
     if (ow, oh) != (w, h):
         y = jax.image.resize(
             y, x.shape[:-3] + (oh, ow, x.shape[-1]), method="bilinear")
+    return y
+
+
+def device_resize_crop_hwc(x: jnp.ndarray, size: int, crop: int,
+                           to_smaller_edge: bool = True) -> jnp.ndarray:
+    """:func:`device_edge_resize_hwc` + the torchvision round-half center
+    crop — the ``--device_resize`` / ``--device_preproc`` resnet50 step
+    prologue. Returns float32 frames in [0, 255] (N, crop, crop, C).
+    """
+    y = device_edge_resize_hwc(x, size, to_smaller_edge)
+    oh, ow = int(y.shape[-3]), int(y.shape[-2])
     i = int(round((oh - crop) / 2.0))
     j = int(round((ow - crop) / 2.0))
     return y[..., i : i + crop, j : j + crop, :]

@@ -134,8 +134,10 @@ class PackSpec:
     depth-k generalization of the one-batch-in-flight pipeline). NOT setting
     ``paged_step`` is the per-model opt-out: the extractor's ``pack_spec``
     omits it (``--paged_batching`` off, ``--show_pred``-adjacent fallbacks,
-    geometry-variable wire formats like ``--device_resize``) and the bucket
-    dispatches exactly as before.
+    the flow collate seam) and the bucket dispatches exactly as before.
+    Raw-pixels wire formats (``--device_resize``/``--device_preproc``) DO
+    page — their slot queues key by decoded geometry, so every page is
+    shape-homogeneous and runs that geometry's compiled family.
     """
 
     batch_size: int

@@ -1063,6 +1063,17 @@ class ExtractionService:
                 # without tailing the log (seconds/bytes are defaultdict
                 # .get reads — atomic enough against the daemon thread)
                 "transfer": self._transfer_stats(),
+                # per-stage wall seconds from the service-lifetime clock
+                # (additive, no schema bump): the decode/transfer split that
+                # tells WHERE preprocessing cost lives — --device_preproc
+                # moves the per-frame PIL/DSP work out of the decode pool
+                # and into the jitted step, and this is the operator-visible
+                # meter for it (tools/service_smoke.py pins the section).
+                # dict() snapshots atomically under the GIL before iterating
+                # — the run loop may be inserting a first-seen stage key
+                "stages": ({k: round(v, 3)
+                            for k, v in dict(self.ex.clock.seconds).items()}
+                           if self.ex.clock is not None else {}),
                 "cache": (dict(self.ex._cache.stats(),
                                coalesced=self._coalescer.coalesced,
                                waiting=self._coalescer.waiting())
