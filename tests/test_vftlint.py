@@ -5,7 +5,8 @@ Two layers:
 - **tier-1 guard**: the full rule suite over this checkout returns zero
   findings (any unannotated regression in jit-purity / host-sync /
   thread-shared-state / explicit-dtype / fault-barrier / fast-registry /
-  lock-order / guarded-by / blocking-under-lock fails this module);
+  lock-order / guarded-by / blocking-under-lock / use-after-donate /
+  recompile-hygiene / wire-dtype / telemetry-schema fails this module);
 - **fixture tests**: per rule, a seeded violation in a tmp tree fires and
   the annotated/clean form stays quiet — the acceptance contract that no
   rule is satisfied by blanket allowlisting.
@@ -36,7 +37,8 @@ from tools.vftlint.rules import fast_registry, lock_order  # noqa: E402
 ALL_RULE_IDS = {
     "blocking-under-lock", "explicit-dtype", "fast-registry",
     "fault-barrier", "guarded-by", "host-sync", "jit-purity",
-    "lock-order", "thread-shared-state",
+    "lock-order", "recompile-hygiene", "telemetry-schema",
+    "thread-shared-state", "use-after-donate", "wire-dtype",
 }
 
 
@@ -869,6 +871,606 @@ def test_blocking_annotation_suppresses(tmp_path):
     assert lint(tmp_path, "blocking-under-lock") == []
 
 
+# ---- use-after-donate -----------------------------------------------------
+
+# the PR-13 wiring shape: jit_paged forwards its fn into sharded_apply,
+# which donates argnum 2 — discovered (not hardcoded) by prepare()
+DONATE_MESH = """
+    import jax
+
+    def sharded_apply(mesh, fn, donate_argnums=()):
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    class MeshRunner:
+        def jit_paged(self, fn):
+            return sharded_apply(self.mesh, fn, donate_argnums=(2,))
+"""
+
+
+def test_donate_fires_on_read_after_direct_donation(tmp_path):
+    write(tmp_path, "video_features_tpu/parallel/bad.py", """
+        import jax
+
+        class R:
+            def run(self, step, x):
+                fn = jax.jit(step, donate_argnums=(0,))
+                buf = self.runner.put(x)
+                out = fn(buf)
+                return out + buf
+    """)
+    found = lint(tmp_path, "use-after-donate")
+    assert len(found) == 1
+    assert "'buf' is read after its buffer was donated" in found[0]
+    assert "jax.jit(donate_argnums=(0,))" in found[0]
+
+
+def test_donate_fires_through_helper_frame_naming_the_chain(tmp_path):
+    """Donation through the discovered wiring wrapper: the finding names
+    the via-call chain jit_paged → sharded_apply."""
+    write(tmp_path, "video_features_tpu/parallel/mesh.py", DONATE_MESH)
+    write(tmp_path, "video_features_tpu/extractors/bad.py", """
+        class E:
+            def pack_spec(self, step, rows, page):
+                fn = self.runner.jit_paged(step)
+                table = self.runner.put(rows)
+                out = fn(self.params, page, table)
+                return self._wait(table)
+    """)
+    found = lint(tmp_path, "use-after-donate")
+    assert len(found) == 1 and "bad.py:7" in found[0]
+    assert "donated at line 6" in found[0]
+    assert "jit_paged → sharded_apply(donate_argnums=(2,))" in found[0]
+    assert "video_features_tpu/parallel/mesh.py" in found[0]
+
+
+def test_donate_quiet_when_rebound_from_output(tmp_path):
+    """The paged contract: the donated table comes back as an output —
+    rebinding the name to the returned buffer is the sanctioned idiom."""
+    write(tmp_path, "video_features_tpu/parallel/mesh.py", DONATE_MESH)
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        class E:
+            def pack_spec(self, step, rows, page):
+                fn = self.runner.jit_paged(step)
+                table = self.runner.put(rows)
+                out, table = fn(self.params, page, table)
+                return self._wait(table)
+    """)
+    assert lint(tmp_path, "use-after-donate") == []
+
+
+def test_donate_host_values_are_not_tracked(tmp_path):
+    """Passing a host array donates the transient device copy; the host
+    original stays valid (the packer's row-table path relies on this)."""
+    write(tmp_path, "video_features_tpu/parallel/ok.py", """
+        import jax
+        import numpy as np
+
+        class R:
+            def run(self, step, rows):
+                fn = jax.jit(step, donate_argnums=(0,))
+                host = np.stack(rows)
+                out = fn(host)
+                return out, host.shape
+    """)
+    assert lint(tmp_path, "use-after-donate") == []
+
+
+def test_donate_fires_on_loop_without_restage(tmp_path):
+    write(tmp_path, "video_features_tpu/parallel/bad.py", """
+        import jax
+
+        class R:
+            def drain(self, step, x, pages):
+                fn = jax.jit(step, donate_argnums=(1,))
+                buf = self.runner.put(x)
+                for page in pages:
+                    out = fn(page, buf)
+    """)
+    found = lint(tmp_path, "use-after-donate")
+    assert len(found) == 1
+    assert "donated inside a loop without being re-staged" in found[0]
+
+
+def test_donate_quiet_on_loop_with_restage(tmp_path):
+    write(tmp_path, "video_features_tpu/parallel/ok.py", """
+        import jax
+
+        class R:
+            def drain(self, step, x, pages):
+                fn = jax.jit(step, donate_argnums=(1,))
+                buf = self.runner.put(x)
+                for page in pages:
+                    out = fn(page, buf)
+                    buf = self.runner.put(out)
+    """)
+    assert lint(tmp_path, "use-after-donate") == []
+
+
+def test_donate_pair_check_fires_when_param_not_returned(tmp_path):
+    write(tmp_path, "video_features_tpu/parallel/bad.py", """
+        import jax
+
+        def paged(params, page, table):
+            return params @ page
+
+        def build():
+            return jax.jit(paged, donate_argnums=(2,))
+    """)
+    found = lint(tmp_path, "use-after-donate")
+    assert len(found) == 1
+    assert "donated parameter 'table' of 'paged' is not returned" in found[0]
+
+
+def test_donate_pair_check_quiet_on_passthrough(tmp_path):
+    write(tmp_path, "video_features_tpu/parallel/ok.py", """
+        import jax
+
+        def paged(params, page, table):
+            return params @ page, table
+
+        def build():
+            return jax.jit(paged, donate_argnums=(2,))
+    """)
+    assert lint(tmp_path, "use-after-donate") == []
+
+
+def test_donate_annotation_suppresses(tmp_path):
+    write(tmp_path, "video_features_tpu/parallel/ok.py", """
+        import jax
+
+        class R:
+            def run(self, step, x):
+                fn = jax.jit(step, donate_argnums=(0,))
+                buf = self.runner.put(x)
+                out = fn(buf)
+                # use-after-donate: shape probe reads metadata, not storage
+                return out, buf.shape
+    """)
+    assert lint(tmp_path, "use-after-donate") == []
+
+
+# ---- recompile-hygiene ----------------------------------------------------
+
+
+def test_recompile_fires_on_jit_in_loop(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/bad.py", """
+        import jax
+
+        class E:
+            def warm(self, fns):
+                for fn in fns:
+                    step = jax.jit(fn)
+    """)
+    found = lint(tmp_path, "recompile-hygiene")
+    assert len(found) == 1
+    assert "constructed inside a loop" in found[0]
+
+
+def test_recompile_fires_on_reachable_from_extract_with_chain(tmp_path):
+    """Construction two frames below extract(): the finding names the
+    via-call chain through the name-based call graph."""
+    write(tmp_path, "video_features_tpu/extractors/bad.py", """
+        import jax
+
+        class E:
+            def extract(self, path):
+                return self._build()(path)
+
+            def _build(self):
+                return jax.jit(self._fwd)
+    """)
+    found = lint(tmp_path, "recompile-hygiene")
+    assert len(found) == 1
+    assert "constructed per call" in found[0]
+    assert "E.extract → E._build" in found[0]
+
+
+def test_recompile_quiet_when_memoized_into_declared_table(tmp_path):
+    """The _paged_fields pattern: a construction dominated by a miss on a
+    declared memo table runs once per key."""
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import jax
+
+        class E:
+            def extract(self, path):
+                return self._step_for(path.depth)(path)
+
+            def _step_for(self, key):
+                cache = self.__dict__.setdefault("_paged_programs", {})
+                if key not in cache:
+                    step = jax.jit(self._fwd)
+                    cache[key] = step
+                return cache[key]
+    """)
+    assert lint(tmp_path, "recompile-hygiene") == []
+
+
+def test_recompile_quiet_in_init_and_cached_property(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import jax
+        from functools import cached_property
+
+        class E:
+            def __init__(self, fwd):
+                self._step = jax.jit(fwd)
+
+            @cached_property
+            def paged(self):
+                return jax.jit(self._paged_fwd)
+
+            def extract(self, path):
+                return self._step(path)
+    """)
+    assert lint(tmp_path, "recompile-hygiene") == []
+
+
+def test_recompile_annotation_suppresses(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import jax
+
+        class E:
+            def extract(self, path):
+                # recompile-hygiene: one-shot CLI path, process exits after
+                step = jax.jit(self._fwd)
+                return step(path)
+    """)
+    assert lint(tmp_path, "recompile-hygiene") == []
+
+
+# ---- wire-dtype -----------------------------------------------------------
+
+
+def test_wire_dtype_fires_on_float_cast_to_staging(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/bad.py", """
+        import numpy as np
+
+        class E:
+            def stage(self, frames):
+                batch = frames.astype(np.float32)
+                return self._put(batch)
+    """)
+    found = lint(tmp_path, "wire-dtype")
+    assert len(found) == 1
+    assert "float-cast value reaches staging sink" in found[0]
+
+
+def test_wire_dtype_fires_through_sink_alias(tmp_path):
+    """`put = self.runner.put` then `put(batch)` is still a staging sink."""
+    write(tmp_path, "video_features_tpu/parallel/bad.py", """
+        class P:
+            def dispatch(self, frames, timed):
+                put = self._put if timed else self.runner.put
+                batch = frames.astype("float32")
+                return put(batch)
+    """)
+    found = lint(tmp_path, "wire-dtype")
+    assert len(found) == 1 and "staging sink" in found[0]
+
+
+def test_wire_dtype_quiet_behind_declared_escape(tmp_path):
+    """Both escape shapes: the `wire = f32 if cfg.float32_wire else u8`
+    IfExp, and a cast lexically inside `if cfg.float32_wire:`."""
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import numpy as np
+
+        class E:
+            def stage(self, frames):
+                wire = np.float32 if self.cfg.float32_wire else np.uint8
+                batch = frames.astype(wire)
+                return self._put(batch)
+
+            def stage_parity(self, frames):
+                if self.cfg.float32_wire:
+                    batch = frames.astype(np.float32)
+                    return self._put(batch)
+                return self._put(frames)
+    """)
+    assert lint(tmp_path, "wire-dtype") == []
+
+
+def test_wire_dtype_uint8_wire_is_quiet(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import numpy as np
+
+        class E:
+            def stage(self, frames):
+                batch = np.ascontiguousarray(frames.astype(np.uint8))
+                return self._put(batch)
+    """)
+    assert lint(tmp_path, "wire-dtype") == []
+
+
+def test_wire_dtype_vggish_is_exempt_wholesale(tmp_path):
+    # float PCM audio wire by design — there is no uint8 wire for waveforms
+    write(tmp_path, "video_features_tpu/extractors/vggish.py", """
+        import numpy as np
+
+        class V:
+            def stage(self, pcm):
+                return self._put(pcm.astype(np.float32))
+    """)
+    assert lint(tmp_path, "wire-dtype") == []
+
+
+def test_wire_dtype_annotation_suppresses(tmp_path):
+    write(tmp_path, "video_features_tpu/extractors/ok.py", """
+        import numpy as np
+
+        class E:
+            def stage(self, frames):
+                batch = frames.astype(np.float32)
+                # wire-dtype: one-off fp32 calibration, not a serving path
+                return self._put(batch)
+    """)
+    assert lint(tmp_path, "wire-dtype") == []
+
+
+# ---- telemetry-schema -----------------------------------------------------
+
+OBS_DOC = """
+    ### Event catalogue
+
+    | Event | Emitted by | Fields (beyond `ts`/`event`) |
+    |---|---|---|
+    | `video_done` | run loops | `video`, `model` |
+    | `video_failed` | terminal accounting | `video`, `model`, `error_class` |
+"""
+
+
+def test_telemetry_fires_on_catalogue_missing_event(tmp_path):
+    write(tmp_path, "docs/observability.md", OBS_DOC)
+    write(tmp_path, "video_features_tpu/serve/s.py", """
+        class S:
+            def run(self, v):
+                self._journal.emit("mystery_event", video=v)
+    """)
+    found = lint(tmp_path, "telemetry-schema")
+    assert len(found) == 1
+    assert "'mystery_event' is not in the docs/observability.md" in found[0]
+
+
+def test_telemetry_fires_through_forwarding_wrapper(tmp_path):
+    """The Extractor._emit shape: the wrapper forwards its event parameter
+    and injects fields; call sites are classified through it."""
+    write(tmp_path, "docs/observability.md", OBS_DOC)
+    write(tmp_path, "video_features_tpu/extractors/base.py", """
+        class E:
+            def _emit(self, event, **fields):
+                if self._journal is not None:
+                    self._journal.emit(event, model=self.name, **fields)
+
+            def extract(self, v):
+                self._emit("mystery_event", video=v)
+    """)
+    found = lint(tmp_path, "telemetry-schema")
+    assert len(found) == 1
+    assert "'mystery_event'" in found[0] and "base.py:8" in found[0]
+
+
+def test_telemetry_fires_on_undocumented_field(tmp_path):
+    write(tmp_path, "docs/observability.md", OBS_DOC)
+    write(tmp_path, "video_features_tpu/serve/s.py", """
+        class S:
+            def run(self, v):
+                self._journal.emit("video_done", video=v, model="m",
+                                   surprise=1)
+    """)
+    found = lint(tmp_path, "telemetry-schema")
+    assert len(found) == 1
+    assert "undocumented field(s) surprise" in found[0]
+
+
+def test_telemetry_quiet_on_documented_events(tmp_path):
+    """Literal and branch-resolved event names, documented fields only."""
+    write(tmp_path, "docs/observability.md", OBS_DOC)
+    write(tmp_path, "video_features_tpu/serve/s.py", """
+        class S:
+            def run(self, v, ok):
+                name = "video_done" if ok else "video_failed"
+                self._journal.emit(name, video=v, model="m")
+    """)
+    assert lint(tmp_path, "telemetry-schema") == []
+
+
+def test_telemetry_unresolvable_event_name_is_a_finding(tmp_path):
+    write(tmp_path, "docs/observability.md", OBS_DOC)
+    write(tmp_path, "video_features_tpu/serve/s.py", """
+        class S:
+            def run(self):
+                self._journal.emit(self.event_name, video=1)
+    """)
+    found = lint(tmp_path, "telemetry-schema")
+    assert len(found) == 1
+    assert "not statically resolvable" in found[0]
+
+
+def test_telemetry_stats_schema_two_way(tmp_path):
+    write(tmp_path, "docs/serving.md", """
+        ## The `stats` payload (schema 1)
+
+        | Field | Meaning |
+        |---|---|
+        | `ok`, `schema` | op success; payload version |
+        | `packing.{real_slots}` | packer totals |
+        | `ghost` | documented but never emitted |
+    """)
+    write(tmp_path, "video_features_tpu/serve/daemon.py", """
+        class S:
+            def stats(self):
+                return {
+                    "ok": True,
+                    "schema": 1,
+                    "packing": {"real_slots": 1, "occupancy": 0.5},
+                    "extra_top": 2,
+                }
+    """)
+    found = lint(tmp_path, "telemetry-schema")
+    assert any("undocumented top-level field 'extra_top'" in f
+               for f in found)
+    assert any("'packing.occupancy' is not in the" in f for f in found)
+    assert any("documents 'ghost' but the stats op no longer emits"
+               in f for f in found)
+    assert len(found) == 3
+
+
+def test_telemetry_stats_quiet_when_documented(tmp_path):
+    write(tmp_path, "docs/serving.md", """
+        ## The `stats` payload (schema 1)
+
+        | Field | Meaning |
+        |---|---|
+        | `ok`, `schema` | op success; payload version |
+        | `packing.{real_slots, occupancy}` | packer totals |
+        | `tenants.<name>.{pending}` | not enumerable: wildcard subs |
+    """)
+    write(tmp_path, "video_features_tpu/serve/daemon.py", """
+        class S:
+            def stats(self):
+                return {
+                    "ok": True,
+                    "schema": 1,
+                    "packing": {"real_slots": 1, "occupancy": 0.5},
+                    "tenants": self.queue.stats(),
+                }
+    """)
+    assert lint(tmp_path, "telemetry-schema") == []
+
+
+def test_telemetry_annotation_suppresses(tmp_path):
+    write(tmp_path, "docs/observability.md", OBS_DOC)
+    write(tmp_path, "video_features_tpu/serve/s.py", """
+        class S:
+            def run(self, v):
+                # telemetry-schema: staging-only probe, stripped pre-release
+                self._journal.emit("probe_event", video=v)
+    """)
+    assert lint(tmp_path, "telemetry-schema") == []
+
+
+# ---- stale-suppression reconciliation -------------------------------------
+
+
+def test_stale_suppression_is_flagged(tmp_path):
+    """An annotation nothing consumed this run is dead weight — the same
+    reconciliation stale lock declarations get."""
+    write(tmp_path, "video_features_tpu/models/m.py", """
+        import jax.numpy as jnp
+        # explicit-dtype: promotion wanted (the violation is long gone)
+        x = jnp.zeros((2,), dtype=jnp.float32)
+    """)
+    found = lint(tmp_path, "explicit-dtype")
+    assert len(found) == 1
+    assert "stale '# explicit-dtype:' suppression" in found[0]
+
+
+def test_live_suppression_is_not_stale(tmp_path):
+    # consumed by the rule → no stale finding, no violation finding
+    write(tmp_path, "video_features_tpu/models/m.py", """
+        import jax.numpy as jnp
+        # explicit-dtype: promotion wanted here
+        x = jnp.asarray([1.0])
+    """)
+    assert lint(tmp_path, "explicit-dtype") == []
+
+
+def test_fast_registry_comment_outside_default_tier_is_stale(
+        tmp_path, monkeypatch):
+    """fast-registry's grammar is file-level (annotation_live override):
+    the comment is live only while the module sits in DEFAULT_TIER."""
+    monkeypatch.setattr(fast_registry, "DEFAULT_TIER", {})
+    _tiered_tree(tmp_path)
+    write(tmp_path, "tests/test_a.py",
+          "# fast-registry: left over from a previous tier\n"
+          "def test_x():\n    pass\n")
+    found = lint(tmp_path, "fast-registry")
+    assert len(found) == 1 and "stale" in found[0]
+
+
+# ---- --changed / --suppressions -------------------------------------------
+
+
+def test_cli_changed_mode_reports_only_the_diff(tmp_path, capsys):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args],
+                       check=True, capture_output=True)
+
+    # committed baseline has a violation; the new (untracked) file has
+    # another — --changed --base HEAD reports only the new one
+    write(tmp_path, "video_features_tpu/models/old.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "base")
+    write(tmp_path, "video_features_tpu/models/new.py",
+          "import jax.numpy as jnp\ny = jnp.arange(3)\n")
+    assert vftlint_main(["--changed", "--base", "HEAD", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "new.py" in out and "old.py" not in out
+
+
+def test_cli_changed_mode_clean_when_no_diff(tmp_path, capsys):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(tmp_path), *args],
+                       check=True, capture_output=True)
+
+    write(tmp_path, "video_features_tpu/models/old.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    git("init", "-q")
+    git("add", "-A")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "base")
+    assert vftlint_main(["--changed", "--base", "HEAD", str(tmp_path)]) == 0
+    assert "no files changed" in capsys.readouterr().out
+
+
+def test_cli_changed_outside_git_lints_everything(tmp_path, capsys):
+    write(tmp_path, "video_features_tpu/models/m.py",
+          "import jax.numpy as jnp\nx = jnp.asarray([1.0])\n")
+    assert vftlint_main(["--changed", str(tmp_path)]) == 1
+    err = capsys.readouterr().err
+    assert "needs a git checkout" in err
+
+
+def test_cli_suppressions_lists_annotations(tmp_path, capsys):
+    write(tmp_path, "video_features_tpu/models/m.py", """
+        import jax.numpy as jnp
+        # explicit-dtype: promotion deliberate here
+        x = jnp.asarray([1.0])
+    """)
+    assert vftlint_main(["--suppressions", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert ("video_features_tpu/models/m.py:3 explicit-dtype "
+            "promotion deliberate here") in out
+
+
+def test_suppression_ledger_matches_docs():
+    """The (file, rule, count) ledger in docs/static-analysis.md mirrors
+    `--suppressions` exactly — adding or removing an annotation without
+    updating the ledger fails here."""
+    from tools.vftlint.core import collect_suppressions
+
+    counts = {}
+    for rel, _line, rule, _reason in collect_suppressions(REPO):
+        counts[(rel, rule)] = counts.get((rel, rule), 0) + 1
+
+    doc = open(os.path.join(REPO, "docs", "static-analysis.md"),
+               encoding="utf-8").read()
+    assert "### Suppression ledger" in doc
+    section = doc.split("### Suppression ledger", 1)[1]
+    section = section.split("\n## ")[0].split("\n### ")[0]
+    documented = {}
+    for line in section.splitlines():
+        if not line.startswith("|") or set(line) <= {"|", "-", " "}:
+            continue
+        cells = [c.strip().strip("`") for c in line.strip("|").split("|")]
+        if len(cells) >= 3 and cells[2].isdigit():
+            documented[(cells[0], cells[1])] = int(cells[2])
+    assert documented == counts
+
+
 # ---- LockOrderWatch (runtime cross-check shim) -----------------------------
 
 
@@ -926,14 +1528,40 @@ def test_sources_parsed_once_per_run(monkeypatch):
 
 
 def test_full_run_wall_clock_budget():
-    """The full 9-rule suite stays within a generous ceiling (the pre-lock-
-    rules baseline was ~1.2 s on this class of machine; the budget guards
-    against O(files x rules) parse regressions, not small constant cost)."""
+    """The full 13-rule suite stays within ~25% over the measured baseline
+    (~3.5 s on this class of machine after the dataflow rules landed) — the
+    budget guards against O(files x rules) parse regressions and against a
+    new interprocedural pass quietly re-deriving the shared analyses, not
+    against small constant cost. Best-of-3 so a loaded machine measures the
+    lint, not the contention."""
     import time
 
-    t0 = time.perf_counter()
-    run_lint(REPO)
-    assert time.perf_counter() - t0 < 6.0
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run_lint(REPO)
+        best = min(best, time.perf_counter() - t0)
+        if best < 4.5:
+            break
+    assert best < 4.5
+
+
+def test_changed_mode_single_file_is_fast():
+    """--changed on a one-file diff stays a pre-commit-speed loop: the tree
+    is still parsed and prepare()d (the interprocedural rules need it), but
+    per-file checks run only on the diff. Best-of-3 — a wall-clock pin under
+    a loaded full-suite run measures contention, not the lint."""
+    import time
+
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        found = run_lint(REPO, only={"video_features_tpu/serve/wal.py"})
+        best = min(best, time.perf_counter() - t0)
+        assert found == []
+        if best < 2.0:
+            break
+    assert best < 2.0
 
 
 # ---- --format json / github ------------------------------------------------

@@ -1,12 +1,46 @@
-"""CLI: ``python -m tools.vftlint [--rule ID ...] [--format F] [root]``."""
+"""CLI: ``python -m tools.vftlint [--rule ID ...] [--format F] [--changed]
+[--suppressions] [root]``."""
 
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
+from typing import Optional, Set
 
-from .core import all_rules, default_root, run_lint
+from .core import all_rules, collect_suppressions, default_root, run_lint
+
+
+def _changed_files(root: str, base: str) -> Optional[Set[str]]:
+    """Repo-relative posix paths differing from ``base`` (committed or
+    worktree) plus untracked files; None when ``root`` is not a git repo.
+    Falls back base → main → HEAD so a fresh clone without an origin still
+    lints its local edits."""
+
+    def git(*args: str) -> subprocess.CompletedProcess:
+        return subprocess.run(["git", "-C", root, *args],
+                              capture_output=True, text=True)
+
+    ref = None
+    for candidate in (base, "main", "HEAD"):
+        if git("rev-parse", "--verify", "--quiet",
+               candidate).returncode == 0:
+            ref = candidate
+            break
+    if ref is None:
+        return None
+    if ref != base:
+        print(f"vftlint: base ref {base!r} not found, diffing against "
+              f"{ref!r}", file=sys.stderr)
+    files: Set[str] = set()
+    for args in (("diff", "--name-only", ref),
+                 ("ls-files", "--others", "--exclude-standard")):
+        proc = git(*args)
+        if proc.returncode == 0:
+            files.update(line.strip() for line in proc.stdout.splitlines()
+                         if line.strip())
+    return files
 
 
 def main(argv=None) -> int:
@@ -20,6 +54,18 @@ def main(argv=None) -> int:
                         help="run only this rule (repeatable)")
     parser.add_argument("--list-rules", action="store_true",
                         help="list registered rules and exit")
+    parser.add_argument("--changed", action="store_true",
+                        help="report findings only for files changed vs "
+                             "--base (the whole tree is still analyzed — "
+                             "the interprocedural rules need it); fast "
+                             "pre-commit loop")
+    parser.add_argument("--base", default="origin/main", metavar="REF",
+                        help="git base ref for --changed (default: "
+                             "origin/main, falling back to main, HEAD)")
+    parser.add_argument("--suppressions", action="store_true",
+                        help="print every in-code suppression annotation "
+                             "(file:line rule-id reason) and exit — the "
+                             "ledger docs/static-analysis.md mirrors")
     parser.add_argument("--format", choices=("text", "json", "github"),
                         default="text", dest="fmt",
                         help="finding output: text (default), json "
@@ -38,8 +84,31 @@ def main(argv=None) -> int:
         return 0
 
     root = args.root or default_root()
+
+    if args.suppressions:
+        entries = collect_suppressions(root)
+        if args.fmt == "json":
+            print(json.dumps([
+                {"file": rel, "line": line, "rule": rule, "reason": reason}
+                for rel, line, rule, reason in entries], indent=2))
+        else:
+            for rel, line, rule, reason in entries:
+                print(f"{rel}:{line} {rule} {reason}")
+        print(f"vftlint: {len(entries)} suppression(s)", file=sys.stderr)
+        return 0
+
+    only = None
+    if args.changed:
+        only = _changed_files(root, args.base)
+        if only is None:
+            print("vftlint: --changed needs a git checkout; linting "
+                  "everything", file=sys.stderr)
+        elif not only:
+            print(f"vftlint: clean — no files changed vs {args.base}")
+            return 0
+
     try:
-        findings = run_lint(root, args.rules)
+        findings = run_lint(root, args.rules, only=only)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
@@ -61,14 +130,15 @@ def main(argv=None) -> int:
         for finding in findings:
             print(finding)
     n_rules = len(args.rules) if args.rules else len(registry)
+    scope = f"{len(only)} changed file(s)" if only is not None else str(root)
     if findings:
         print(f"vftlint: {len(findings)} finding(s) from {n_rules} rule(s)",
               file=sys.stderr)
         return 1
     if args.fmt == "text":
-        print(f"vftlint: clean — {n_rules} rule(s) over {root}")
+        print(f"vftlint: clean — {n_rules} rule(s) over {scope}")
     else:
-        print(f"vftlint: clean — {n_rules} rule(s) over {root}",
+        print(f"vftlint: clean — {n_rules} rule(s) over {scope}",
               file=sys.stderr)
     return 0
 

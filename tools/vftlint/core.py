@@ -59,15 +59,27 @@ class SourceFile:
             self.tree = ast.parse(self.text, filename=self.rel)
         except SyntaxError as e:
             self.parse_error = e
-        self.comments: Dict[int, str] = {}
-        try:
-            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
-                if tok.type == tokenize.COMMENT:
-                    # last comment on a line wins; lines have at most one anyway
-                    self.comments[tok.start[0]] = tok.string
-        except (tokenize.TokenError, IndentationError):
-            pass  # the AST parse error already reports this file
+        self._comments: Optional[Dict[int, str]] = None
         self._traced = None  # memoized tracing.traced_functions result
+        # (rule_id, comment line) pairs a rule actually looked up this run —
+        # the stale-suppression pass flags annotations nothing consumed
+        self.consumed: set = set()
+
+    @property
+    def comments(self) -> Dict[int, str]:
+        """Per-line comments, tokenized lazily: ``--changed`` mode only
+        checks (and so only tokenizes) the files in the diff."""
+        if self._comments is None:
+            self._comments = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.text).readline):
+                    if tok.type == tokenize.COMMENT:
+                        # last comment on a line wins; at most one per line
+                        self._comments[tok.start[0]] = tok.string
+            except (tokenize.TokenError, IndentationError):
+                pass  # the AST parse error already reports this file
+        return self._comments
 
     def traced(self):
         """Memoized jit-traced FunctionDef discovery — jit-purity and
@@ -89,6 +101,7 @@ class SourceFile:
             comment = self.comments.get(ln)
             if comment is None or marker not in comment:
                 continue
+            self.consumed.add((rule_id, ln))
             return comment.split(marker, 1)[1].strip()
         return None
 
@@ -118,6 +131,13 @@ class Rule:
         return ()
 
     # -- shared helpers -----------------------------------------------------
+
+    def annotation_live(self, src: SourceFile, line: int) -> bool:
+        """Is the ``# <id>:`` annotation at comment ``line`` still backed by
+        a would-be finding? Default: the rule looked it up this run (via
+        :meth:`SourceFile.annotation`). Rules with their own annotation
+        grammar (fault-barrier's line regex) override."""
+        return (self.id, line) in src.consumed
 
     def suppressed(self, src: SourceFile, line: int,
                    extra: List[Finding]) -> bool:
@@ -169,9 +189,20 @@ def _walk_py(root: str, sub: str) -> List[str]:
 
 
 def run_lint(root: str,
-             rule_ids: Optional[Sequence[str]] = None) -> List[Finding]:
+             rule_ids: Optional[Sequence[str]] = None,
+             only: Optional[Iterable[str]] = None) -> List[Finding]:
     """Run the selected rules (default: all) over ``root``; findings sorted
-    by file/line. Unknown rule ids raise KeyError (the CLI maps it to exit 2)."""
+    by file/line. Unknown rule ids raise KeyError (the CLI maps it to exit 2).
+
+    ``only`` (repo-relative posix paths) is ``--changed`` mode: the full
+    tree is still parsed and ``prepare()``d — the interprocedural rules
+    (lock model, donation wiring, telemetry wrappers) need the whole
+    package to judge one file — but per-file checks run only on the listed
+    files, and findings are filtered to them. Cross-file ``finalize``
+    reconciliation that depends on observations from *unchanged* files
+    (e.g. a stale-declaration sweep) under-approximates here; the full run
+    (CI's lint job) is the authority, ``--changed`` is the fast
+    pre-commit loop."""
     registry = all_rules()
     if rule_ids:
         missing = [r for r in rule_ids if r not in registry]
@@ -200,10 +231,13 @@ def run_lint(root: str,
     shared: Dict[str, object] = {}
     for rule in rules:
         rule.prepare(root, sources, shared)
+    checked = None if only is None else set(only)
     findings: List[Finding] = []
     parse_reported = set()
     for rule, rels in per_rule_rels:
         for rel in rels:
+            if checked is not None and rel not in checked:
+                continue
             src = sources[rel]
             if src.parse_error is not None:
                 if rel not in parse_reported:
@@ -214,7 +248,71 @@ def run_lint(root: str,
                 continue
             findings.extend(rule.check_file(src))
         findings.extend(rule.finalize(root))
+    # stale-suppression reconciliation: an annotation comment no finding
+    # consumed this run is dead weight — the same discipline stale lock
+    # declarations already get (a suppression that outlives its violation
+    # silently licenses the next one)
+    for rule, rels in per_rule_rels:
+        marker = rule.id + ":"
+        for rel in rels:
+            if checked is not None and rel not in checked:
+                continue
+            src = sources[rel]
+            if src.parse_error is not None:
+                continue
+            for ln, comment in sorted(src.comments.items()):
+                if marker not in comment:
+                    continue
+                if rule.annotation_live(src, ln):
+                    continue
+                findings.append(Finding(
+                    rel, ln, rule.id,
+                    f"stale '# {rule.id}:' suppression — nothing fires "
+                    "here anymore; delete the comment (reconciliation, "
+                    "same as stale lock declarations)"))
+    if only is not None:
+        allowed = set(only)
+        findings = [f for f in findings if f.path in allowed]
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule, f.message))
+
+
+def collect_suppressions(
+        root: str) -> List[Tuple[str, int, str, str]]:
+    """Every in-code suppression annotation, as (rel, line, rule-id,
+    reason), sorted. Scans exactly the files each registered rule scans, so
+    an annotation outside a rule's roots (which that rule can never read)
+    is not counted as a suppression."""
+    registry = all_rules()
+    comments_cache: Dict[str, Dict[int, str]] = {}
+
+    def comments_of(rel: str) -> Dict[int, str]:
+        if rel not in comments_cache:
+            out: Dict[int, str] = {}
+            path = os.path.join(root, rel.replace("/", os.sep))
+            try:
+                with open(path, encoding="utf-8") as f:
+                    text = f.read()
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(text).readline):
+                    if tok.type == tokenize.COMMENT:
+                        out[tok.start[0]] = tok.string
+            except (OSError, tokenize.TokenError, IndentationError):
+                pass
+            comments_cache[rel] = out
+        return comments_cache[rel]
+
+    entries = set()
+    for rule in registry.values():
+        marker = rule.id + ":"
+        for sub in rule.roots:
+            for rel in _walk_py(root, sub):
+                if not rule.wants(rel):
+                    continue
+                for ln, comment in comments_of(rel).items():
+                    if marker in comment:
+                        reason = comment.split(marker, 1)[1].strip()
+                        entries.add((rel, ln, rule.id, reason))
+    return sorted(entries)
 
 
 def default_root() -> str:

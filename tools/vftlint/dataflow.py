@@ -1,0 +1,233 @@
+"""Shared intraprocedural value-flow scaffolding for vftlint rules.
+
+PR 11's host-sync rule proved a pattern: a *line-order* abstract
+interpretation over one function body — branch-union at ``if``/``else``,
+kill-on-reassign, nested ``def`` bodies seeded with the closure's state —
+catches the device-boundary bugs a type checker can't see, without the cost
+or fragility of a real fixpoint. This module generalizes that pass into
+reusable pieces so the v3 rules (use-after-donate, recompile-hygiene,
+wire-dtype, telemetry-schema) share one walker instead of four forks:
+
+- :class:`LineOrderScanner` — the statement-structure walk extracted from
+  host-sync's ``_TaintScanner`` (which now subclasses it). Subclasses own an
+  arbitrary abstract state and implement ``snapshot``/``restore``/``merged``
+  plus ``visit_expr`` (compound-statement heads) and ``visit_simple``
+  (simple statements, including assignment transfer).
+- :class:`StringFlow` — a concrete scanner resolving the *possible literal
+  strings* a name can hold at each use point (``Constant``/``Name``/
+  ``IfExp``/``or`` chains), used by telemetry-schema to resolve dynamic
+  event-name arguments (e.g. the scheduler's ``_note_queued(job, event)``
+  helper, whose call sites pass literals).
+- :func:`walk_no_defs` — re-exported from :mod:`.locks`: expression walk
+  that does not descend into nested ``def``/``lambda``/``class`` bodies
+  (they execute later, in a different scope).
+
+Single pass, no back-edge fixpoint, same deliberate limitation host-sync
+documents: a fact born at the bottom of a loop body is not visible at its
+top. Rules that care about loop back-edges (use-after-donate's re-staging
+check) get explicit ``begin_loop``/``end_loop`` hooks instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, FrozenSet, Optional
+
+from .locks import _walk_no_defs as walk_no_defs  # noqa: F401  (re-export)
+
+
+class LineOrderScanner:
+    """Line-order statement walk with branch-union state (see module doc).
+
+    The contract host-sync's fixtures pin, now shared:
+
+    - ``if``/``else``: each branch scans from the pre-branch state; the
+      after-state is the union (a kill in one branch doesn't kill globally);
+    - compound-statement heads (tests, iterables, with-items) are visited
+      *before* their blocks — a block must see the state updates that scope
+      it, never the stale pre-block state;
+    - nested ``def``: scanned with a fork of the closure's current state,
+      then the outer state is restored (closures see the enclosing facts,
+      their own writes don't leak out);
+    - ``class`` bodies inside functions are separate runtime scopes: skipped.
+    """
+
+    # -- state protocol (subclasses implement) ------------------------------
+
+    def snapshot(self):
+        raise NotImplementedError
+
+    def restore(self, token) -> None:
+        raise NotImplementedError
+
+    def merged(self, tokens):
+        raise NotImplementedError
+
+    # -- visit hooks --------------------------------------------------------
+
+    def visit_expr(self, expr: ast.AST) -> None:
+        """A compound statement's head expression (if-test, for-iter,
+        while-test, with-item), visited before the block it scopes."""
+
+    def visit_simple(self, stmt: ast.stmt) -> None:
+        """A simple statement — sink checks and assignment transfer."""
+
+    def on_for(self, stmt) -> None:
+        """Called after ``visit_expr(stmt.iter)``, before the body."""
+
+    def begin_loop(self, stmt) -> None:
+        """Entering a For/While body (use-after-donate's back-edge hook)."""
+
+    def end_loop(self, stmt) -> None:
+        """Leaving a For/While body (before the else-block)."""
+
+    def scan_branch(self, body, stmt: ast.If, index: int) -> None:
+        """One ``if`` arm (0 = body, 1 = orelse) — override to push
+        branch-scoped context (wire-dtype's ``float32_wire`` gate)."""
+        self.scan_block(body)
+
+    def nested_def(self, stmt) -> None:
+        token = self.snapshot()
+        self.scan_block(stmt.body)
+        self.restore(token)
+
+    # -- the walk -----------------------------------------------------------
+
+    def scan_block(self, stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.nested_def(stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            elif isinstance(stmt, ast.If):
+                self.visit_expr(stmt.test)
+                pre = self.snapshot()
+                outs = []
+                for index, branch in enumerate((stmt.body, stmt.orelse)):
+                    self.restore(pre)
+                    self.scan_branch(branch, stmt, index)
+                    outs.append(self.snapshot())
+                self.restore(self.merged(outs))
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.visit_expr(stmt.iter)
+                self.on_for(stmt)
+                self.begin_loop(stmt)
+                self.scan_block(stmt.body)
+                self.end_loop(stmt)
+                self.scan_block(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self.visit_expr(stmt.test)
+                self.begin_loop(stmt)
+                self.scan_block(stmt.body)
+                self.end_loop(stmt)
+                self.scan_block(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self.visit_expr(item.context_expr)
+                self.scan_block(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.scan_block(stmt.body)
+                for handler in stmt.handlers:
+                    self.scan_block(handler.body)
+                self.scan_block(stmt.orelse)
+                self.scan_block(stmt.finalbody)
+            else:
+                self.visit_simple(stmt)
+
+
+# ---------------------------------------------------------------------------
+# literal-string resolution
+
+# env value: frozenset of possible strings, or None = unknown (TOP)
+StrEnv = Dict[str, Optional[FrozenSet[str]]]
+
+
+def literal_strings(expr: ast.AST, env: StrEnv) -> Optional[FrozenSet[str]]:
+    """Possible literal-string values of ``expr`` under ``env``; None when
+    any contributor is unresolvable (which makes the whole value unknown —
+    a partial answer would let undocumented events hide behind one dynamic
+    branch)."""
+    if isinstance(expr, ast.Constant):
+        return frozenset({expr.value}) if isinstance(expr.value, str) else None
+    if isinstance(expr, ast.Name):
+        return env.get(expr.id)
+    if isinstance(expr, ast.IfExp):
+        a = literal_strings(expr.body, env)
+        b = literal_strings(expr.orelse, env)
+        return a | b if a is not None and b is not None else None
+    if isinstance(expr, ast.BoolOp) and isinstance(expr.op, ast.Or):
+        out: FrozenSet[str] = frozenset()
+        for v in expr.values:
+            part = literal_strings(v, env)
+            if part is None:
+                return None
+            out |= part
+        return out
+    return None
+
+
+class StringFlow(LineOrderScanner):
+    """Track name → possible-literal-strings through one function body and
+    invoke ``on_call(call, env)`` for every call, in statement order with
+    the environment live at that point."""
+
+    def __init__(self, on_call: Callable[[ast.Call, StrEnv], None],
+                 seed: Optional[StrEnv] = None):
+        self.on_call = on_call
+        self.env: StrEnv = dict(seed or {})
+
+    def snapshot(self):
+        return dict(self.env)
+
+    def restore(self, token) -> None:
+        self.env = dict(token)
+
+    def merged(self, tokens):
+        keys = set()
+        for t in tokens:
+            keys |= set(t)
+        out: StrEnv = {}
+        for k in keys:
+            vals: FrozenSet[str] = frozenset()
+            for t in tokens:
+                v = t.get(k)
+                if v is None:
+                    vals = None  # type: ignore[assignment]
+                    break
+                vals |= v
+            out[k] = vals
+        return out
+
+    def _calls(self, node: ast.AST) -> None:
+        for sub in walk_no_defs(node):
+            if isinstance(sub, ast.Call):
+                self.on_call(sub, self.env)
+
+    def visit_expr(self, expr: ast.AST) -> None:
+        self._calls(expr)
+
+    def visit_simple(self, stmt: ast.stmt) -> None:
+        self._calls(stmt)
+        if isinstance(stmt, ast.Assign):
+            value = literal_strings(stmt.value, self.env)
+            for target in stmt.targets:
+                self._bind(target, value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._bind(stmt.target, literal_strings(stmt.value, self.env))
+        elif isinstance(stmt, ast.AugAssign):
+            self._bind(stmt.target, None)
+
+    def _bind(self, target: ast.AST, value) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = value
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, None)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, None)
+
+
+def scan_function_strings(fn, on_call: Callable[[ast.Call, StrEnv], None],
+                          seed: Optional[StrEnv] = None) -> None:
+    """Run a :class:`StringFlow` over one function body."""
+    StringFlow(on_call, seed).scan_block(fn.body)

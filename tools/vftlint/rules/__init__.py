@@ -12,5 +12,9 @@ from . import (  # noqa: F401
     host_sync,
     jit_purity,
     lock_order,
+    recompile_hygiene,
+    telemetry_schema,
     thread_shared_state,
+    use_after_donate,
+    wire_dtype,
 )

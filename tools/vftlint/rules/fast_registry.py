@@ -89,6 +89,15 @@ class FastRegistryRule(Rule):
         return name == "conftest.py" or (
             name.startswith("test_") and name.endswith(".py"))
 
+    def annotation_live(self, src: SourceFile, line: int) -> bool:
+        # this rule's grammar is file-level, not line-level: the comment
+        # declares why a DEFAULT_TIER module sits in the default tier
+        # (finalize reads src.comments directly, so the consumed-set default
+        # never sees it). Live iff the module is still declared DEFAULT_TIER
+        # — a module that leaves the tier makes its comment stale.
+        name = os.path.basename(src.rel)
+        return name.endswith(".py") and name[:-3] in DEFAULT_TIER
+
     def check_file(self, src: SourceFile) -> Iterable[Finding]:
         name = os.path.basename(src.rel)
         if name == "conftest.py":

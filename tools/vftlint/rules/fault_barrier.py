@@ -100,6 +100,16 @@ class FaultBarrierRule(Rule):
     title = "broad excepts only at declared, annotated fault barriers"
     roots = ("video_features_tpu",)
 
+    def annotation_live(self, src, line: int) -> bool:
+        # this rule's grammar is line-level (the marker must sit on the
+        # broad-except line itself, or the line above it vftlint-style), so
+        # "live" means: the annotated line is still a broad except
+        lines = src.text.splitlines()
+        for ln in (line, line + 1):
+            if 1 <= ln <= len(lines) and BROAD.match(lines[ln - 1]):
+                return True
+        return False
+
     # scan() is whole-tree; run it once from finalize instead of per file
     def finalize(self, root: str) -> Iterable[Finding]:
         findings: List[Finding] = []

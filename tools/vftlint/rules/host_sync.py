@@ -34,6 +34,7 @@ import re
 from typing import Iterable, List, Set
 
 from ..core import Finding, Rule, SourceFile, register
+from ..dataflow import LineOrderScanner
 from ..tracing import dotted_name, walk_body
 
 # attribute names whose CALL yields a device value
@@ -60,8 +61,14 @@ def _is_device_callable_expr(node: ast.AST) -> bool:
     return False
 
 
-class _TaintScanner:
-    """One function body's line-order taint pass."""
+class _TaintScanner(LineOrderScanner):
+    """One function body's line-order taint pass.
+
+    The statement walk (branch-union ``if``, closure-seeded nested defs,
+    compound heads visited before their blocks) lives in
+    :class:`~tools.vftlint.dataflow.LineOrderScanner`; this class supplies
+    the host-sync state — tainted names and device-callable names — and the
+    sink checks."""
 
     def __init__(self, rule: "HostSyncRule", src: SourceFile,
                  findings: List[Finding]):
@@ -70,6 +77,22 @@ class _TaintScanner:
         self.findings = findings
         self.tainted: Set[str] = set()
         self.device_callables: Set[str] = set()
+
+    # -- LineOrderScanner state protocol ------------------------------------
+
+    def snapshot(self):
+        return (set(self.tainted), set(self.device_callables))
+
+    def restore(self, token) -> None:
+        self.tainted, self.device_callables = set(token[0]), set(token[1])
+
+    def merged(self, tokens):
+        out_t: Set[str] = set()
+        out_c: Set[str] = set()
+        for t, c in tokens:
+            out_t |= t
+            out_c |= c
+        return (out_t, out_c)
 
     # -- expression taint ---------------------------------------------------
 
@@ -145,59 +168,25 @@ class _TaintScanner:
                 "route host materialization through self._wait() "
                 "(metrics 'device_wait') instead"))
 
-    # -- statement walk -----------------------------------------------------
+    # -- statement-walk hooks (structure lives in LineOrderScanner) ---------
 
-    def scan_block(self, stmts) -> None:
-        for stmt in stmts:
-            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # nested def: fresh scanner seeded with the closure's state
-                inner = _TaintScanner(self.rule, self.src, self.findings)
-                inner.tainted = set(self.tainted)
-                inner.device_callables = set(self.device_callables)
-                inner.scan_block(stmt.body)
-            elif isinstance(stmt, ast.If):
-                self.check_sinks(stmt.test)
-                # each branch starts from the pre-branch state; afterwards
-                # taints union (a kill in one branch doesn't kill globally)
-                pre = (set(self.tainted), set(self.device_callables))
-                out_t: Set[str] = set()
-                out_c: Set[str] = set()
-                for branch in (stmt.body, stmt.orelse):
-                    self.tainted, self.device_callables = set(pre[0]), set(pre[1])
-                    self.scan_block(branch)
-                    out_t |= self.tainted
-                    out_c |= self.device_callables
-                self.tainted, self.device_callables = out_t, out_c
-            elif isinstance(stmt, ast.For):
-                self.check_sinks(stmt.iter)
-                if self.is_tainted(stmt.iter):
-                    self._mark(stmt.target, True)
-                self.scan_block(stmt.body)
-                self.scan_block(stmt.orelse)
-            elif isinstance(stmt, ast.While):
-                self.check_sinks(stmt.test)
-                self.scan_block(stmt.body)
-                self.scan_block(stmt.orelse)
-            elif isinstance(stmt, ast.With):
-                for item in stmt.items:
-                    self.check_sinks(item.context_expr)
-                self.scan_block(stmt.body)
-            elif isinstance(stmt, ast.Try):
-                self.scan_block(stmt.body)
-                for handler in stmt.handlers:
-                    self.scan_block(handler.body)
-                self.scan_block(stmt.orelse)
-                self.scan_block(stmt.finalbody)
-            else:
-                # simple statement: no nested blocks, safe to walk whole
-                self.check_sinks(stmt)
-                if isinstance(stmt, ast.Assign):
-                    self._assign(stmt.targets, stmt.value)
-                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
-                    self._assign([stmt.target], stmt.value)
-                elif isinstance(stmt, ast.AugAssign):
-                    if self.is_tainted(stmt.value):
-                        self._mark(stmt.target, True)
+    def visit_expr(self, expr: ast.AST) -> None:
+        self.check_sinks(expr)
+
+    def on_for(self, stmt) -> None:
+        if self.is_tainted(stmt.iter):
+            self._mark(stmt.target, True)
+
+    def visit_simple(self, stmt: ast.stmt) -> None:
+        # simple statement: no nested blocks, safe to walk whole
+        self.check_sinks(stmt)
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            if self.is_tainted(stmt.value):
+                self._mark(stmt.target, True)
 
     def _assign(self, targets, value) -> None:
         tainted = self.is_tainted(value)

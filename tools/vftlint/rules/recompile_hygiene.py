@@ -1,0 +1,212 @@
+"""recompile-hygiene: jitted callables are built once, not per call.
+
+PR 13 fixed a real leak: ``pack_spec()`` rebuilt its paged program on every
+call, so every video paid a fresh XLA compile (seconds on TPU) and the
+compile cache grew without bound. The fix — ``Extractor._paged_fields``
+memoizing ``runner.jit_paged(paged_program(forward))`` per (forward,
+page_rows, depth) — is the contract this rule freezes mechanically.
+
+A *jit construction* is a call whose name ends in ``jit``/``jit_paged``/
+``sharded_apply``/``paged_program``/``pjit`` (``jax.jit``, ``runner.jit``,
+bare ``sharded_apply`` — the wiring in ``parallel/mesh.py``). One is a
+finding when it happens:
+
+- lexically inside a ``for``/``while`` loop, or
+- in a function reachable from any ``pack_spec()``/``extract()`` method via
+  the name-based call graph (:mod:`tools.vftlint.locks` — the same
+  resolution the lock rules use), i.e. it runs per video / per batch,
+
+unless the constructed callable flows into a **declared memo table**
+(:data:`MEMO_TABLES`): the construction is dominated by a miss on
+``self._paged_programs[...]`` / ``self._frames_steps[...]`` (directly or
+through a local alias like ``cache = self.__dict__.setdefault(...)``), so
+it runs once per key.
+
+Construction sites that are once-per-object by construction are exempt:
+``__init__``, ``functools.cached_property``/``property``-decorated getters,
+and the wiring functions themselves (``sharded_apply``/``MeshRunner.jit``/
+``jit_paged``/``paged_program`` exist to build jitted callables). Those
+exempt functions are also barriers for reachability — a builder invoked
+only from a ``cached_property`` getter runs once, not per call.
+
+Suppress a deliberate per-call construction with
+``# recompile-hygiene: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, register
+from ..dataflow import walk_no_defs
+from ..locks import FnSummary, shared_model
+from ..tracing import dotted_name
+
+# call last-names that construct a jitted callable
+CONSTRUCTORS = {"jit", "pjit", "jit_paged", "sharded_apply", "paged_program"}
+
+# per-call entry points: these run per video / per packed batch
+ENTRYPOINTS = {"pack_spec", "extract"}
+
+# declared memo tables: a construction stored into one is once-per-key
+MEMO_TABLES = {"_paged_programs", "_frames_steps"}
+
+# once-per-object decorators (construction inside these is hoisted by design)
+_ONCE_DECORATORS = {"cached_property", "property", "lru_cache", "cache"}
+
+
+def _is_exempt(fn: FnSummary) -> bool:
+    if fn.name == "__init__" or fn.name in CONSTRUCTORS:
+        return True
+    for dec in getattr(fn.node, "decorator_list", ()):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target) or ""
+        if name.rsplit(".", 1)[-1] in _ONCE_DECORATORS:
+            return True
+    return False
+
+
+def _construct_call(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted_name(node.func) or ""
+    if name.rsplit(".", 1)[-1] in CONSTRUCTORS:
+        return name
+    return None
+
+
+class _Site:
+    __slots__ = ("call", "name", "target", "in_loop")
+
+    def __init__(self, call: ast.Call, name: str,
+                 target: Optional[str], in_loop: bool):
+        self.call = call
+        self.name = name          # dotted constructor name, for the message
+        self.target = target      # single Name the result is assigned to
+        self.in_loop = in_loop
+
+
+def _scan_fn(fn: FnSummary) -> Tuple[List[_Site], Set[str]]:
+    """(construction sites, names stored into a declared memo table) for one
+    function body — nested defs excluded (they are their own summaries)."""
+    aliases: Set[str] = set()
+    for sub in walk_no_defs(ast.Module(body=fn.node.body, type_ignores=[])):
+        if not isinstance(sub, ast.Assign):
+            continue
+        mentions_memo = any(
+            (isinstance(n, ast.Attribute) and n.attr in MEMO_TABLES)
+            or (isinstance(n, ast.Constant) and n.value in MEMO_TABLES)
+            for n in ast.walk(sub.value))
+        if mentions_memo:
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    aliases.add(t.id)
+
+    sites: List[_Site] = []
+    stored: Set[str] = set()
+
+    def exprs(st: ast.stmt):
+        for child in ast.iter_child_nodes(st):
+            if not isinstance(child, ast.stmt):
+                yield child
+
+    def visit(stmts, in_loop: bool) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            target: Optional[str] = None
+            if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                    and isinstance(st.targets[0], ast.Name)):
+                target = st.targets[0].id
+            if isinstance(st, ast.Assign):
+                for t in st.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and isinstance(st.value, ast.Name)):
+                        table = t.value
+                        if ((isinstance(table, ast.Attribute)
+                             and table.attr in MEMO_TABLES)
+                                or (isinstance(table, ast.Name)
+                                    and table.id in aliases)):
+                            stored.add(st.value.id)
+            for expr in exprs(st):
+                for sub in walk_no_defs(expr):
+                    name = _construct_call(sub)
+                    if name is not None:
+                        sites.append(_Site(sub, name, target, in_loop))
+            loop = in_loop or isinstance(st, (ast.For, ast.AsyncFor,
+                                              ast.While))
+            for field in ("body", "orelse", "finalbody"):
+                visit(getattr(st, field, []) or [], loop)
+            for handler in getattr(st, "handlers", []) or []:
+                visit(handler.body, loop)
+
+    visit(fn.node.body, False)
+    return sites, stored
+
+
+@register
+class RecompileHygieneRule(Rule):
+    id = "recompile-hygiene"
+    title = "jit construction memoized, not per pack_spec()/extract() call"
+    roots = ("video_features_tpu",)
+
+    def prepare(self, root: str, sources, shared) -> None:
+        self._model = shared_model(root, sources, shared)
+        # BFS from every pack_spec/extract over the name-based call graph;
+        # exempt functions are barriers (they run once per object)
+        self._via: Dict[int, Tuple[str, ...]] = {}
+        queue: List[FnSummary] = []
+        for fn in self._model.functions:
+            if fn.name in ENTRYPOINTS:
+                self._via[id(fn)] = (fn.qual,)
+                queue.append(fn)
+        while queue:
+            fn = queue.pop(0)
+            if _is_exempt(fn):
+                continue
+            chain = self._via[id(fn)]
+            if len(chain) >= 5:
+                continue
+            for callee in self._model.callees(fn):
+                if id(callee) not in self._via:
+                    self._via[id(callee)] = chain + (callee.qual,)
+                    queue.append(callee)
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for fn in self._model.functions_in(src.rel):
+            sites, stored = _scan_fn(fn)
+            if not sites:
+                continue
+            exempt = _is_exempt(fn)
+            chain = self._via.get(id(fn))
+            for site in sites:
+                memoized = site.target is not None and site.target in stored
+                if memoized:
+                    continue
+                if site.in_loop:
+                    if self.suppressed(src, site.call.lineno, findings):
+                        continue
+                    findings.append(Finding(
+                        src.rel, site.call.lineno, self.id,
+                        f"{site.name}() constructed inside a loop — every "
+                        "iteration pays a fresh XLA compile; hoist it or "
+                        "memoize into a declared table "
+                        f"({', '.join(sorted(MEMO_TABLES))}, the "
+                        "_paged_fields pattern)"))
+                    continue
+                if exempt or chain is None:
+                    continue
+                if self.suppressed(src, site.call.lineno, findings):
+                    continue
+                findings.append(Finding(
+                    src.rel, site.call.lineno, self.id,
+                    f"{site.name}() constructed per call: '{fn.qual}' is "
+                    f"reachable from the per-video path via "
+                    f"{' → '.join(chain)} — memoize into a declared table "
+                    f"({', '.join(sorted(MEMO_TABLES))}) or hoist to "
+                    "__init__/cached_property"))
+        return sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.message))

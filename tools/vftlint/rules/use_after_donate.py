@@ -1,0 +1,421 @@
+"""use-after-donate: a donated device buffer is dead after dispatch.
+
+``sharded_apply`` forwards ``donate_argnums`` into ``jax.jit`` so the paged
+row table's device buffer is recycled in place (PR 13: ``MeshRunner.jit_paged``
+donates argnum 2). Donation is a transfer of ownership: after the donating
+call returns, the *input* buffer's storage belongs to the output — reading
+it, returning it, or re-staging it is undefined behavior that XLA only
+sometimes reports (and on TPU usually manifests as silently corrupt rows).
+
+Two checks, both riding the shared line-order pass
+(:mod:`tools.vftlint.dataflow`):
+
+1. **Use after donation** — within a function, a *device-tagged* name passed
+   at a donated argnum position of a donating callable must not be read on
+   any subsequent path before reassignment. Donating callables are resolved
+   through the wiring: direct ``jax.jit(..., donate_argnums=(...))`` /
+   ``sharded_apply(..., donate_argnums=(...))`` calls, plus package wrapper
+   functions that forward their own parameter into such a call with a
+   literal donation (``MeshRunner.jit_paged``) — discovered in ``prepare()``
+   so findings name the via-chain. A donation inside a loop whose buffer is
+   never re-staged in the loop body is flagged too: the second iteration
+   would dispatch an already-donated buffer.
+2. **In/out pair** — every ``donate_argnums`` declaration must name a
+   parameter the wrapped function returns (the shape/dtype-identical in/out
+   pair XLA needs to alias the buffers; ``paged_program``'s ``paged`` passes
+   the row table through verbatim). Wrapped functions are resolved by name
+   within the module, one helper hop deep (``paged_program(forward)``
+   resolves to the nested ``paged`` it returns).
+
+Only *device* values (results of ``runner.put``/``self._put``/
+``jax.device_put``/``prefetch_to_device``/step calls/donating calls) are
+tracked at donated positions: passing a host ``numpy`` array donates the
+transient device *copy*, and the host original stays valid (the packer's
+row-table path relies on this).
+
+Suppress a deliberate exception with ``# use-after-donate: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Finding, Rule, SourceFile, register
+from ..dataflow import LineOrderScanner, walk_no_defs
+from ..tracing import dotted_name
+
+# call names that CREATE a jitted callable and accept donate_argnums directly
+_BASE_FN_ARG = {"jit": 0, "pjit": 0, "sharded_apply": 1}
+
+# calls whose RESULT is a fresh device value (reading it later is fine; and
+# passing `f(x)` at a donated position donates f's result, not any name)
+_DEVICE_PRODUCERS = {"put", "put_replicated", "_put", "_put_replicated",
+                     "device_put", "prefetch_to_device", "_stage_rows"}
+
+
+def _literal_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The literal ``donate_argnums`` of ``call``, or None when absent or
+    not statically resolvable (e.g. forwarded from an enclosing parameter —
+    that's the wiring function itself, checked at its call sites)."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        node = kw.value
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.elts:
+                if not (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, int)):
+                    return None
+                out.append(elt.value)
+            return tuple(out)
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        return None
+    return None
+
+
+def _donating_base_call(call: ast.Call):
+    """(argnums, fn_arg_index, via) for a direct donating constructor call."""
+    name = dotted_name(call.func) or ""
+    last = name.rsplit(".", 1)[-1]
+    if last not in _BASE_FN_ARG:
+        return None
+    argnums = _literal_argnums(call)
+    if not argnums:
+        return None
+    return argnums, _BASE_FN_ARG[last], f"{name}(donate_argnums={argnums})"
+
+
+class _DonateSpec:
+    """A callable that donates: which argnums, and the wiring chain that
+    makes it so (for the finding message)."""
+
+    def __init__(self, argnums: Tuple[int, ...], via: str):
+        self.argnums = argnums
+        self.via = via
+
+
+class _Scanner(LineOrderScanner):
+    """Per-function donation tracking: ``donating`` (name → spec),
+    ``device`` (device-tagged names), ``donated`` (name → (line, via))."""
+
+    def __init__(self, rule: "UseAfterDonateRule", src: SourceFile,
+                 findings: List[Finding]):
+        self.rule = rule
+        self.src = src
+        self.findings = findings
+        self.donating: Dict[str, _DonateSpec] = {}
+        self.device: Set[str] = set()
+        self.donated: Dict[str, Tuple[int, str]] = {}
+        self._loops: List[Tuple[Set[str], Set[str]]] = []  # (pre-donated, assigned-in-loop)
+
+    # -- state protocol -----------------------------------------------------
+
+    def snapshot(self):
+        return (dict(self.donating), set(self.device), dict(self.donated))
+
+    def restore(self, token) -> None:
+        self.donating = dict(token[0])
+        self.device = set(token[1])
+        self.donated = dict(token[2])
+
+    def merged(self, tokens):
+        donating: Dict[str, _DonateSpec] = {}
+        device: Set[str] = set()
+        donated: Dict[str, Tuple[int, str]] = {}
+        for d, dev, don in tokens:
+            donating.update(d)
+            device |= dev
+            donated.update(don)
+        return (donating, device, donated)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _spec_for_call(self, call: ast.Call) -> Optional[_DonateSpec]:
+        """Spec if ``call`` invokes a donating callable (a tracked local
+        name, or a known wiring wrapper like ``runner.jit_paged``)."""
+        if isinstance(call.func, ast.Name):
+            return self.donating.get(call.func.id)
+        name = dotted_name(call.func) or ""
+        last = name.rsplit(".", 1)[-1]
+        return self.rule.wrappers.get(last)
+
+    def _constructed_spec(self, value: ast.AST) -> Optional[_DonateSpec]:
+        """Spec when ``value`` constructs a donating callable."""
+        if not isinstance(value, ast.Call):
+            return None
+        base = _donating_base_call(value)
+        if base is not None:
+            argnums, _, via = base
+            return _DonateSpec(argnums, via)
+        name = dotted_name(value.func) or ""
+        wrapper = self.rule.wrappers.get(name.rsplit(".", 1)[-1])
+        if wrapper is not None:
+            return wrapper
+        return None
+
+    def _is_device_value(self, value: ast.AST) -> bool:
+        if isinstance(value, ast.Name):
+            return value.id in self.device
+        if isinstance(value, ast.Call):
+            name = dotted_name(value.func) or ""
+            if name.rsplit(".", 1)[-1] in _DEVICE_PRODUCERS:
+                return True
+            return self._spec_for_call(value) is not None
+        return False
+
+    # -- checks -------------------------------------------------------------
+
+    def _check_reads(self, node: ast.AST) -> None:
+        if not self.donated:
+            return
+        for sub in walk_no_defs(node):
+            if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                    and sub.id in self.donated):
+                line, via = self.donated[sub.id]
+                # avoid double-reporting every read of the same donation
+                del self.donated[sub.id]
+                if self.rule.suppressed(self.src, sub.lineno, self.findings):
+                    continue
+                self.findings.append(Finding(
+                    self.src.rel, sub.lineno, self.rule.id,
+                    f"'{sub.id}' is read after its buffer was donated at "
+                    f"line {line} (via {via}) — a donated input's storage "
+                    "belongs to the output after dispatch; re-stage a fresh "
+                    "copy or drop the read"))
+
+    def _record_donations(self, node: ast.AST) -> None:
+        for call in walk_no_defs(node):
+            if not isinstance(call, ast.Call):
+                continue
+            spec = self._spec_for_call(call)
+            if spec is None:
+                continue
+            for argnum in spec.argnums:
+                if argnum >= len(call.args):
+                    continue
+                arg = call.args[argnum]
+                if (isinstance(arg, ast.Name)
+                        and arg.id in self.device):
+                    self.donated[arg.id] = (call.lineno, spec.via)
+
+    # -- walk hooks ---------------------------------------------------------
+
+    def visit_expr(self, expr: ast.AST) -> None:
+        self._check_reads(expr)
+        self._record_donations(expr)
+
+    def visit_simple(self, stmt: ast.stmt) -> None:
+        self._check_reads(stmt)
+        self._record_donations(stmt)
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            self._kill(stmt.target)
+
+    def _assign(self, targets, value: ast.AST) -> None:
+        spec = self._constructed_spec(value)
+        device = self._is_device_value(value)
+        for target in targets:
+            self._kill(target)
+            if isinstance(target, ast.Name):
+                if spec is not None:
+                    self.donating[target.id] = spec
+                if device:
+                    self.device.add(target.id)
+
+    def _kill(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            self.donating.pop(target.id, None)
+            self.device.discard(target.id)
+            self.donated.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._kill(elt)
+        elif isinstance(target, ast.Starred):
+            self._kill(target.value)
+
+    # -- loop back-edge: donation without re-staging ------------------------
+
+    def on_for(self, stmt) -> None:
+        self._kill(stmt.target)
+
+    def begin_loop(self, stmt) -> None:
+        assigned: Set[str] = set()
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Name) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                assigned.add(sub.id)
+        self._loops.append((set(self.donated), assigned))
+
+    def end_loop(self, stmt) -> None:
+        pre_donated, loop_assigned = self._loops.pop()
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for name, (line, via) in list(self.donated.items()):
+            if name in pre_donated or not (stmt.lineno <= line <= end):
+                continue
+            if name in loop_assigned:
+                continue
+            del self.donated[name]
+            if self.rule.suppressed(self.src, line, self.findings):
+                continue
+            self.findings.append(Finding(
+                self.src.rel, line, self.rule.id,
+                f"'{name}' is donated inside a loop without being re-staged "
+                f"in the body (via {via}) — the next iteration would "
+                "dispatch an already-donated buffer"))
+
+
+@register
+class UseAfterDonateRule(Rule):
+    id = "use-after-donate"
+    title = "donated device buffers are dead after the donating call"
+    roots = ("video_features_tpu",)
+    wrappers: Dict[str, _DonateSpec] = {}
+
+    def prepare(self, root: str, sources, shared) -> None:
+        # discover wiring wrappers: package functions that forward their own
+        # parameter into a donating constructor with a literal donation
+        self.wrappers = {}
+        for rel, src in sorted(sources.items()):
+            if getattr(src, "tree", None) is None:
+                continue
+            if not rel.startswith("video_features_tpu/"):
+                continue
+            if "donate_argnums" not in src.text:  # cheap pre-filter
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                params = [a.arg for a in node.args.args]
+                for call in ast.walk(node):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    base = _donating_base_call(call)
+                    if base is None:
+                        continue
+                    argnums, fn_idx, via = base
+                    if fn_idx >= len(call.args):
+                        continue
+                    fn_expr = call.args[fn_idx]
+                    if (isinstance(fn_expr, ast.Name)
+                            and fn_expr.id in params):
+                        self.wrappers[node.name] = _DonateSpec(
+                            argnums,
+                            f"{node.name} → {via} [{rel}:{call.lineno}]")
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        defs = [n for n in ast.walk(src.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        nested = {sub for fn in defs for sub in ast.walk(fn)
+                  if sub is not fn
+                  and isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in defs:
+            if node in nested:
+                continue
+            _Scanner(self, src, findings).scan_block(node.body)
+        self._check_pairs(src, findings)
+        return sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.message))
+
+    # -- donation in/out pair check -----------------------------------------
+
+    def _check_pairs(self, src: SourceFile,
+                     findings: List[Finding]) -> None:
+        """Every donating-constructor call whose wrapped fn resolves to a
+        function in this module must return the donated parameter: XLA can
+        only alias a donated input into a shape/dtype-identical output."""
+        defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        def resolve(fn_expr: ast.AST) -> Optional[ast.FunctionDef]:
+            if isinstance(fn_expr, ast.Name):
+                cands = defs_by_name.get(fn_expr.id, [])
+                return cands[0] if len(cands) == 1 else None
+            if isinstance(fn_expr, ast.Call):
+                # one helper hop: paged_program(forward) → the nested def
+                # its body returns
+                name = dotted_name(fn_expr.func) or ""
+                cands = defs_by_name.get(name.rsplit(".", 1)[-1], [])
+                if len(cands) != 1:
+                    return None
+                for stmt in cands[0].body:
+                    if (isinstance(stmt, ast.Return)
+                            and isinstance(stmt.value, ast.Name)):
+                        inner = [n for n in ast.walk(cands[0])
+                                 if isinstance(n, ast.FunctionDef)
+                                 and n.name == stmt.value.id]
+                        return inner[0] if len(inner) == 1 else None
+            return None
+
+        for call in ast.walk(src.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            spec_via: Optional[str] = None
+            argnums: Tuple[int, ...] = ()
+            fn_expr: Optional[ast.AST] = None
+            base = _donating_base_call(call)
+            if base is not None:
+                argnums, fn_idx, spec_via = base
+                if fn_idx < len(call.args):
+                    fn_expr = call.args[fn_idx]
+            else:
+                name = dotted_name(call.func) or ""
+                wrapper = self.wrappers.get(name.rsplit(".", 1)[-1])
+                if wrapper is not None and call.args:
+                    argnums, spec_via = wrapper.argnums, wrapper.via
+                    fn_expr = call.args[0]
+            if fn_expr is None:
+                continue
+            target = resolve(fn_expr)
+            if target is None:
+                continue
+            params = [a.arg for a in target.args.args]
+            for argnum in argnums:
+                if argnum >= len(params):
+                    if self.suppressed(src, call.lineno, findings):
+                        continue
+                    findings.append(Finding(
+                        src.rel, call.lineno, self.id,
+                        f"donate_argnums={argnums} names no parameter of "
+                        f"'{target.name}' (it takes {len(params)}) — via "
+                        f"{spec_via}"))
+                    continue
+                param = params[argnum]
+                for ret in self._returns(target):
+                    value = ret.value
+                    names = []
+                    if isinstance(value, ast.Name):
+                        names = [value.id]
+                    elif isinstance(value, ast.Tuple):
+                        names = [e.id for e in value.elts
+                                 if isinstance(e, ast.Name)]
+                    if param not in names:
+                        if self.suppressed(src, ret.lineno, findings):
+                            continue
+                        findings.append(Finding(
+                            src.rel, ret.lineno, self.id,
+                            f"donated parameter '{param}' of "
+                            f"'{target.name}' is not returned here — "
+                            "donation needs a shape/dtype-identical in/out "
+                            "pair (pass the buffer through verbatim, like "
+                            f"the paged row table); via {spec_via}"))
+
+    @staticmethod
+    def _returns(fn: ast.FunctionDef) -> Iterable[ast.Return]:
+        # returns of nested defs belong to those defs, not fn
+        for stmt in fn.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue
+            for node in walk_no_defs(stmt):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    yield node

@@ -1,0 +1,265 @@
+"""wire-dtype: the frame wire ships uint8; floats are made on device.
+
+PR 14 moved normalization/resize into the jitted step precisely so the
+host→device wire carries raw ``uint8`` pixels — a float32 wire is 4× the
+PCIe/ICI bytes and erases the win. The one sanctioned exception is the
+``--float32_wire`` escape (``flow.py``'s ``self._wire = np.float32 if
+cfg.float32_wire else np.uint8``), kept for parity runs against the
+reference checkpoints.
+
+This rule taints values produced by ``.astype(<float dtype>)`` (and values
+derived from them — slicing, arithmetic, ``np.ascontiguousarray``,
+``HostStagingRing.stage`` assembly) and flags any tainted value reaching a
+*staging sink*: ``self._put`` / ``_put_replicated`` / ``runner.put`` /
+``put_replicated`` / ``jax.device_put`` / ``_stage_rows`` /
+``prefetch_to_device``, including calls through a local alias
+(``put = self._put if timed else self.runner.put``). Casts *inside* traced
+step bodies are invisible here by construction — they happen on device,
+which is the whole point.
+
+The escape is structural, not a suppression: a cast or sink lexically
+guarded by a ``float32_wire`` conditional (the ``if`` test or ``IfExp``
+mentions the flag) is exempt. Audio is exempt wholesale — VGGish ships
+float PCM by design (``extractors/vggish.py``; there is no uint8 wire for
+waveforms).
+
+Suppress a deliberate float staging with ``# wire-dtype: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ..core import Finding, Rule, SourceFile, register
+from ..dataflow import LineOrderScanner, walk_no_defs
+from ..tracing import dotted_name
+
+FLOAT_DTYPES = {"float", "float16", "float32", "float64", "bfloat16",
+                "half", "single", "double"}
+
+# call last-names that stage a host buffer onto the device
+_SINK_NAMES = {"_put", "_put_replicated", "_stage_rows",
+               "device_put", "prefetch_to_device"}
+# attr names that are sinks when read through a runner-/staging-ish receiver
+_RECV_SINKS = {"put": ("runner",), "put_replicated": ("runner",),
+               "stage": ("staging", "ring"), "commit": ("staging", "ring")}
+
+_ESCAPE_TOKEN = "float32_wire"
+
+# python files exempt wholesale: float PCM audio wire by design
+_EXEMPT_FILES = {"video_features_tpu/extractors/vggish.py"}
+
+
+def _mentions_escape(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and _ESCAPE_TOKEN in sub.attr:
+            return True
+        if isinstance(sub, ast.Name) and _ESCAPE_TOKEN in sub.id:
+            return True
+    return False
+
+
+def _float_dtype_literal(node: ast.AST) -> bool:
+    """Is ``node`` a literal float dtype (``np.float32``, ``jnp.bfloat16``,
+    ``"float32"``, bare ``float``)?"""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in FLOAT_DTYPES or node.value.startswith("float")
+    name = dotted_name(node) or ""
+    return name.rsplit(".", 1)[-1] in FLOAT_DTYPES
+
+
+def _is_sink_attr(node: ast.AST) -> bool:
+    """An attribute READ that denotes a staging sink (for alias tracking)."""
+    if not isinstance(node, ast.Attribute):
+        return False
+    if node.attr in _SINK_NAMES:
+        return True
+    tokens = _RECV_SINKS.get(node.attr)
+    if tokens is None:
+        return False
+    recv = (dotted_name(node.value) or "").lower()
+    return any(t in recv for t in tokens)
+
+
+class _Scanner(LineOrderScanner):
+    """State: ``tainted`` names (hold host float-cast frame data),
+    ``float_names`` (names bound to an unconditional float dtype literal),
+    ``sink_aliases`` (names bound to a staging-sink bound method)."""
+
+    def __init__(self, rule: "WireDtypeRule", src: SourceFile,
+                 findings: List[Finding]):
+        self.rule = rule
+        self.src = src
+        self.findings = findings
+        self.tainted: Set[str] = set()
+        self.float_names: Set[str] = set()
+        self.sink_aliases: Set[str] = set()
+        self._escape_depth = 0
+
+    # -- state protocol -----------------------------------------------------
+
+    def snapshot(self):
+        return (set(self.tainted), set(self.float_names),
+                set(self.sink_aliases))
+
+    def restore(self, token) -> None:
+        self.tainted = set(token[0])
+        self.float_names = set(token[1])
+        self.sink_aliases = set(token[2])
+
+    def merged(self, tokens):
+        out = [set(), set(), set()]
+        for t in tokens:
+            for i in range(3):
+                out[i] |= t[i]
+        return tuple(out)
+
+    # -- taint --------------------------------------------------------------
+
+    def _casts_float(self, dtype_arg: ast.AST) -> bool:
+        if _float_dtype_literal(dtype_arg):
+            return True
+        if isinstance(dtype_arg, ast.Name):
+            return dtype_arg.id in self.float_names
+        return False
+
+    def is_tainted(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Subscript):
+            return self.is_tainted(node.value)
+        if isinstance(node, ast.Starred):
+            return self.is_tainted(node.value)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.is_tainted(e) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            return self.is_tainted(node.left) or self.is_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.is_tainted(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self.is_tainted(node.body) or self.is_tainted(node.orelse)
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype" and node.args
+                    and self._casts_float(node.args[0])):
+                return True
+            # a call on/of tainted data stays tainted (ascontiguousarray,
+            # staging assembly, reshape…)
+            if any(self.is_tainted(a) for a in node.args):
+                return True
+            if (isinstance(node.func, ast.Attribute)
+                    and self.is_tainted(node.func.value)):
+                return True
+        return False
+
+    # -- sinks --------------------------------------------------------------
+
+    def _check_sinks(self, root: ast.AST) -> None:
+        if self._escape_depth:
+            return
+        for node in walk_no_defs(root):
+            if not isinstance(node, ast.Call):
+                continue
+            is_sink = _is_sink_attr(node.func) or (
+                isinstance(node.func, ast.Name)
+                and (node.func.id in self.sink_aliases
+                     or node.func.id in _SINK_NAMES))
+            if not is_sink:
+                continue
+            if not any(self.is_tainted(a) for a in node.args):
+                continue
+            label = dotted_name(node.func) or getattr(
+                node.func, "attr", "put")
+            if self.rule.suppressed(self.src, node.lineno, self.findings):
+                continue
+            self.findings.append(Finding(
+                self.src.rel, node.lineno, self.rule.id,
+                f"float-cast value reaches staging sink {label}() — the "
+                "frame wire ships uint8 (cast on device inside the jitted "
+                "step); deliberate float staging belongs behind the "
+                "--float32_wire escape"))
+
+    # -- walk hooks ---------------------------------------------------------
+
+    def visit_expr(self, expr: ast.AST) -> None:
+        self._check_sinks(expr)
+
+    def scan_branch(self, body, stmt: ast.If, index: int) -> None:
+        # `if cfg.float32_wire:` — the true arm is the declared escape
+        gated = index == 0 and _mentions_escape(stmt.test)
+        if gated:
+            self._escape_depth += 1
+        self.scan_block(body)
+        if gated:
+            self._escape_depth -= 1
+
+    def visit_simple(self, stmt: ast.stmt) -> None:
+        self._check_sinks(stmt)
+        if isinstance(stmt, ast.Assign):
+            self._assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign([stmt.target], stmt.value)
+        elif isinstance(stmt, ast.AugAssign):
+            if self.is_tainted(stmt.value):
+                self._mark(stmt.target, True)
+
+    def _assign(self, targets, value: ast.AST) -> None:
+        # `wire = np.float32 if cfg.float32_wire else np.uint8` is the
+        # declared escape shape: the name is NOT an unconditional float
+        escaped = isinstance(value, ast.IfExp) and _mentions_escape(
+            value.test)
+        tainted = not escaped and not self._escape_depth and self.is_tainted(
+            value)
+        floaty = (not escaped and not self._escape_depth
+                  and _float_dtype_literal(value))
+        sink_alias = _is_sink_attr(value) or (
+            isinstance(value, ast.IfExp)
+            and (_is_sink_attr(value.body) or _is_sink_attr(value.orelse)))
+        for target in targets:
+            self._mark(target, tainted)
+            if isinstance(target, ast.Name):
+                if floaty:
+                    self.float_names.add(target.id)
+                else:
+                    self.float_names.discard(target.id)
+                if sink_alias:
+                    self.sink_aliases.add(target.id)
+                else:
+                    self.sink_aliases.discard(target.id)
+
+    def _mark(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._mark(elt, tainted)
+        elif isinstance(target, ast.Starred):
+            self._mark(target.value, tainted)
+
+
+@register
+class WireDtypeRule(Rule):
+    id = "wire-dtype"
+    title = "frame staging ships uint8; floats behind --float32_wire only"
+    roots = ("video_features_tpu/extractors", "video_features_tpu/parallel")
+
+    def wants(self, rel: str) -> bool:
+        return rel.endswith(".py") and rel not in _EXEMPT_FILES
+
+    def check_file(self, src: SourceFile) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        defs = [n for n in ast.walk(src.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        nested = {sub for fn in defs for sub in ast.walk(fn)
+                  if sub is not fn
+                  and isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in defs:
+            if node in nested:
+                continue
+            _Scanner(self, src, findings).scan_block(node.body)
+        return sorted(set(findings),
+                      key=lambda f: (f.path, f.line, f.message))

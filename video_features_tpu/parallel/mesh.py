@@ -160,7 +160,14 @@ class MeshRunner:
         identical in shape/dtype/layout to its input (int32 ``(page_rows, 3)``
         in, passed through unchanged), so XLA aliases it in place — the
         legal-donation seam :func:`sharded_apply` documents. Pages themselves
-        stay undonated: uint8 in, fp32 features out never alias."""
+        stay undonated: uint8 in, fp32 features out never alias.
+
+        This wiring is statically checked: vftlint's ``use-after-donate``
+        rule discovers the ``jit_paged → sharded_apply(donate_argnums=…)``
+        forwarding chain (not hardcoded — docs/static-analysis.md), so a
+        caller that reads its table after dispatch, loops without
+        re-staging, or a paged fn that stops returning the table, fails
+        lint with this chain named in the finding."""
         return sharded_apply(self.mesh, paged_fn, n_batch_args=2,
                              matmul_precision=self.matmul_precision,
                              donate_argnums=(2,))

@@ -287,7 +287,7 @@ class AdmissionLog:
             t.flush()
             os.fsync(t.fileno())
         os.replace(tmp, self.path)
-        self.compactions += 1  # thread-shared-state: written only by the single writer thread; stats readers take a GIL-atomic monotone int load
+        self.compactions += 1  # single-writer discipline: written only by the single writer thread; stats readers take a GIL-atomic monotone int load
         return open(self.path, "a")
 
     def _drain(self) -> None:
@@ -335,7 +335,7 @@ class AdmissionLog:
                         fault_point("wal_append", rec.get("request", ""))
                         f.write(json.dumps(rec, default=str) + "\n")
                         wrote = True
-                        self.appended += 1  # thread-shared-state: written only by the single writer thread; stats readers take a GIL-atomic monotone int load
+                        self.appended += 1  # single-writer discipline: written only by the single writer thread; stats readers take a GIL-atomic monotone int load
                     except (OSError, OutputError) as e:
                         self._degrade(e)
                         f = None
@@ -352,7 +352,7 @@ class AdmissionLog:
                             or now - last_fsync >= self._fsync_sec):
                         os.fsync(f.fileno())
                         last_fsync = now
-                        self._last_sync = now  # thread-shared-state: written only by the single writer thread; healthz readers take a GIL-atomic monotone float load
+                        self._last_sync = now  # single-writer discipline: written only by the single writer thread; healthz readers take a GIL-atomic monotone float load
                 except (OSError, OutputError) as e:
                     self._degrade(e)
                     f = None
