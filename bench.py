@@ -1040,6 +1040,109 @@ def main() -> None:
                  f"(strictly below: "
                  f"{entry['paged_waste_strictly_below_bucketed']})")
 
+    # ---- segmented intra-video decode (--decode_segments, docs/performance.md)
+    # A decode-bound corpus: few LONG videos on a pool with spare workers —
+    # the shape where cross-video parallelism cannot help and sequential
+    # decode pins the pipeline at single-stream speed. Same corpus through
+    # sequential decode (--decode_segments 1) and forced 4-way segmentation;
+    # decode critical-path s/video comes from the telemetry journal's decode
+    # spans (a segmented video's decode wall is max(span end) − min(span
+    # start) across its segment streams). Acceptance: segmented decode
+    # s/video strictly lower, packing occupancy no worse, and the two modes'
+    # saved features byte-identical (the parity invariant, checked end to
+    # end — a non-parity stitch fails the scenario outright).
+    if not over_budget("long_video_segmented"):
+        with guarded("long_video_segmented"):
+            n_long = 2 if on_cpu else 4
+            frames_long = 360 if on_cpu else 900
+            corpus = write_corpus(
+                "long_corpus",
+                [((160, 120) if on_cpu else (320, 240), frames_long)] * n_long)
+            seg_workers = 4 if on_cpu else 8
+
+            def seg_decode_walls(tdir):
+                """(mean decode critical-path sec/video, segment span count)."""
+                starts: dict = {}
+                ends: dict = {}
+                seg_spans = 0
+                with open(os.path.join(tdir, "events.jsonl")) as f:
+                    for line in f:
+                        try:
+                            ev = json.loads(line)
+                        except ValueError:
+                            continue
+                        if ev.get("event") == "decode_start":
+                            starts.setdefault(ev["video"], []).append(ev["ts"])
+                            seg_spans += "segment" in ev
+                        elif ev.get("event") == "decode_end":
+                            ends.setdefault(ev["video"], []).append(ev["ts"])
+                walls = [max(ends[v]) - min(starts[v])
+                         for v in starts if v in ends]
+                return sum(walls) / max(len(walls), 1), seg_spans
+
+            def run_seg_mode(key, segs):
+                tdir = os.path.join("/tmp/vft_bench", f"segdec_{key}")
+                shutil.rmtree(tdir, ignore_errors=True)
+                ex = ExtractResNet50(cfg(
+                    "resnet50", batch_size=4 if on_cpu else 64,
+                    pack_corpus=True, on_extraction="save_numpy",
+                    decode_workers=seg_workers, decode_segments=segs,
+                    # native resampler: the ffmpeg re-encode path is never
+                    # segmented, and parity must compare like against like
+                    extraction_fps=1, use_ffmpeg="never",
+                    telemetry_dir=tdir))
+                _force(ex._step(ex.params, ex.runner.put(
+                    rng.integers(0, 256, (ex.batch_size, 224, 224, 3),
+                                 dtype=np.uint8))))  # warm outside the clock
+                shutil.rmtree(ex.output_dir, ignore_errors=True)
+                t0 = time.perf_counter()
+                ok = ex.run(corpus)
+                wall = time.perf_counter() - t0
+                if ok != n_long:
+                    raise RuntimeError(f"{key} pass extracted {ok}/{n_long}")
+                decode_wall, seg_spans = seg_decode_walls(tdir)
+                outputs = {
+                    name: open(os.path.join(ex.output_dir, name), "rb").read()
+                    for name in sorted(os.listdir(ex.output_dir))
+                    if name.endswith(".npy")}
+                return {
+                    "videos_per_sec": round(ok / wall, 3),
+                    "wall_sec": round(wall, 3),
+                    "decode_sec_per_video": round(decode_wall, 4),
+                    "segment_spans": seg_spans,
+                    "occupancy": ex._pack_stats["occupancy"],
+                }, outputs
+
+            _log(f"long_video_segmented: {n_long} videos × {frames_long} "
+                 f"frames, {seg_workers} decode workers, sequential vs "
+                 f"4-way segments")
+            entry = {"videos": n_long, "frames_per_video": frames_long,
+                     "decode_workers": seg_workers, "unit": "videos",
+                     "code_rev": code_rev}
+            entry["sequential"], seq_outs = run_seg_mode("sequential", 1)
+            entry["segmented"], seg_outs = run_seg_mode("segmented", 4)
+            entry["byte_parity"] = bool(seq_outs == seg_outs)
+            entry["decode_strictly_faster"] = bool(
+                entry["segmented"]["decode_sec_per_video"]
+                < entry["sequential"]["decode_sec_per_video"])
+            entry["occupancy_no_worse"] = bool(
+                entry["segmented"]["occupancy"]
+                >= entry["sequential"]["occupancy"])
+            details["long_video_segmented"] = entry
+            clear_failure("long_video_segmented")
+            flush_details()
+            if not entry["byte_parity"]:
+                raise RuntimeError(
+                    "long_video_segmented: segmented features are NOT "
+                    "byte-identical to sequential decode")
+            _log(f"long_video_segmented: decode "
+                 f"{entry['sequential']['decode_sec_per_video']}s → "
+                 f"{entry['segmented']['decode_sec_per_video']}s per video "
+                 f"(strictly faster: {entry['decode_strictly_faster']}), "
+                 f"occupancy {entry['sequential']['occupancy']} → "
+                 f"{entry['segmented']['occupancy']}, byte parity: "
+                 f"{entry['byte_parity']}")
+
     if not over_budget("packed_vggish"):
         with guarded("packed_vggish"):
             from scipy.io import wavfile

@@ -38,6 +38,7 @@ DEFAULT_TIER: Dict[str, str] = {
     "test_packer_models": "real-model packed parity (jit compiles)",
     "test_paged": "paged dispatch parity (jit compiles)",
     "test_resnet": "resnet50 forward parity (heavy compile)",
+    "test_segmented_decode": "real-sleep pool concurrency + e2e parity runs",
     "test_vggish": "vggish DSP + forward parity",
     "test_weights_store": "checkpoint store roundtrips",
     "test_windows": "pre-dates the fast registry; re-tier on the next sweep",

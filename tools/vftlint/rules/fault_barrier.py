@@ -26,11 +26,11 @@ from ..core import Finding, Rule, register
 # Declared barriers: package-relative posix path -> expected broad-catch count.
 ALLOWED: Dict[str, int] = {
     "video_features_tpu/cache/store.py": 2,        # read + publish: a cache entry of ANY state must degrade to a miss / pass-through, never crash the video it would have saved
-    "video_features_tpu/extractors/base.py": 6,    # per-video fault barrier (per-video + packed loops) + packed finalize + corpus-flush arms + async-write reap arm + unwind-path write accounting
+    "video_features_tpu/extractors/base.py": 7,    # per-video fault barrier (per-video + packed loops) + packed finalize + corpus-flush arms + async-write reap arm + unwind-path write accounting + segment-planner probe (falls back to sequential open)
     "video_features_tpu/extractors/flow.py": 3,    # async-copy + imshow probes + precompile warmup
     "video_features_tpu/io/output.py": 1,          # writer thread: error stored on the WriteHandle
     "video_features_tpu/parallel/packer.py": 4,    # stale-flush + corpus-flush, dispatch + scatter arms each: every bucket's victims, not the finisher or a healthy co-resident bucket/model, own the failure
-    "video_features_tpu/parallel/pipeline.py": 2,  # distributed-client probe + worker re-raise
+    "video_features_tpu/parallel/pipeline.py": 3,  # distributed-client probe + worker re-raise + segment planner (falls back to sequential scheduling)
     "video_features_tpu/reliability/retry.py": 2,  # classified re-raise + attempts attr
     "video_features_tpu/reliability/watchdog.py": 1,  # hands the exception to the waiter
     "video_features_tpu/run.py": 1,                # best-effort JAX_PLATFORMS shim

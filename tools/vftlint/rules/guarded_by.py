@@ -83,6 +83,12 @@ GUARDED_BY: Dict[str, Dict[str, str]] = {
     "video_features_tpu/parallel/pipeline.py": {
         "slot['bytes']": "slot",
         "self._debt": "resize",
+        # segmented-decode permit accounting + stats counters: written by
+        # schedule()/workers, read by spare_permits()/segment_stats()
+        "self._busy": "resize",
+        "self._pending_baselines": "resize",
+        "self._videos_segmented": "resize",
+        "self._segments_decoded": "resize",
     },
     "video_features_tpu/extractors/flow.py": {
         "self._precompiled": "precompile",

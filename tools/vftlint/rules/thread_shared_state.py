@@ -93,6 +93,12 @@ SHARED_WRITES: Dict[str, Dict[str, str]] = {
         "slot['bytes']": "guarded by slot['lock'] (worker increments after "
                          "enqueue; the consumer drain decrements after "
                          "dequeue under the same lock)",
+        # segmented decode: the only NEW cross-thread store a decode worker
+        # makes is its completion counter; the permit-accounting counters
+        # (_busy/_pending_baselines/_videos_segmented) are stored from
+        # schedule()-caller helpers and policed by the GUARDED_BY table
+        "self._segments_decoded": "guarded by the 'resize' lock "
+                                  "(segment_stats reads under it)",
     },
     "video_features_tpu/obs/journal.py": {
         "self._written": "written only by the single writer thread; stats "

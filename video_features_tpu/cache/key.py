@@ -83,6 +83,13 @@ EXECUTION_FIELDS = (
     "resume",                  # skip logic
     "prefetch_depth",          # transfer pipelining
     "decode_workers",          # host decode parallelism
+    "decode_segments",         # intra-video segmented decode: the stitched
+                               # stream is byte-identical to sequential by
+                               # construction (pinned by
+                               # tests/test_segmented_decode.py)
+    "segment_seek",            # seek mechanics for the same coded frames;
+                               # every backend the auto policy accepts lands
+                               # frame-exact (parity pinned as above)
     "pack_flush_age",          # dispatch timing, not numerics
     "paged_batching",          # dispatch mechanics; page outputs byte-match
                                # bucketed (pinned by tests/test_paged.py)

@@ -140,6 +140,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "0 = auto (start from a CPU-derived size; the "
                              "--serve daemon then grows/shrinks the pool live "
                              "from the measured occupancy vs decode signal)")
+    parser.add_argument("--decode_segments", type=int, default=0,
+                        help="segmented intra-video decode: split one video "
+                             "into seek-aligned segments decoded concurrently "
+                             "by the pool and streamed back in order, "
+                             "byte-identical to sequential decode; 0 = auto "
+                             "(segment long videos when the pool has idle "
+                             "permits), 1 = off, N caps the split; needs "
+                             "--decode_workers > 1")
+    parser.add_argument("--segment_seek", default="auto",
+                        choices=["auto", "ffmpeg", "cv2"],
+                        help="seek backend landing a segment on its start "
+                             "frame: auto = verified cv2 CAP_PROP_POS_FRAMES "
+                             "seek with ffmpeg -ss fast-seek fallback for "
+                             "resampled streams cv2 cannot land on; "
+                             "cv2/ffmpeg force a backend")
     parser.add_argument("--pack_corpus", action="store_true", default=False,
                         help="corpus-level clip packing: fill every device "
                              "batch with clips from however many videos are "

@@ -62,6 +62,23 @@ class ExtractionConfig:
     # thread-per-GPU; SPMD centralizes devices, so decode streams are explicit).
     # 1 = inline decode. Frame-stream models only (resnet50, raft, pwc, i3d).
     decode_workers: int = 1
+    # Segmented intra-video decode: split one video into seek-aligned
+    # segments decoded concurrently by the pool and streamed back in order —
+    # byte-identical to sequential decode by construction (io/video.py
+    # plan_segments; docs/performance.md "Segmented decode"). 0 = auto
+    # (segment only long videos, and only when the pool has wholly idle
+    # permits); 1 = off; N >= 2 caps the split. Needs --decode_workers > 1.
+    # The ffmpeg RE-ENCODE resample path (--extraction_fps with ffmpeg
+    # installed and use_ffmpeg auto/always) is never segmented — it decodes
+    # a different, re-encoded container whose parity anchor is sequential.
+    decode_segments: int = 0
+    # How a non-first segment lands frame-exact on its start frame: "auto"
+    # seeks with cv2 CAP_PROP_POS_FRAMES when the backend's landing verifies
+    # (same decoder as sequential decode — the byte-parity guarantee), falls
+    # back to the ffmpeg -ss fast-seek rawvideo streamer (keyframe snap +
+    # lead-in drop) for resampled streams it cannot land on, else to an
+    # exact decode-and-drop rescan. "cv2"/"ffmpeg" force a backend.
+    segment_seek: str = "auto"
     # Corpus-level clip packing (--pack_corpus): fill every fixed-shape device
     # batch with clips from however many videos are ready (the tail batch of
     # video N packs with the head of video N+1) instead of zero-padding each
@@ -398,6 +415,11 @@ class ExtractionConfig:
                              "(start small; the --serve daemon resizes the "
                              "pool live from the measured decode-starvation "
                              "signal)")
+        if self.decode_segments < 0:
+            raise ValueError("decode_segments must be >= 2 to cap the split, "
+                             "1 to disable, or 0 for auto")
+        if self.segment_seek not in ("auto", "ffmpeg", "cv2"):
+            raise ValueError("segment_seek must be auto|ffmpeg|cv2")
         if self.pack_buckets < 1:
             raise ValueError("pack_buckets must be >= 1")
         if self.pack_flush_age < 0:

@@ -32,7 +32,8 @@ from ..io.output import (
     load_done_set,
     write_outputs,
 )
-from ..io.video import open_video
+from ..io.video import open_video, open_video_segment, plan_segments, probe_video
+from ..io import ffmpeg as ffmpeg_io
 from ..parallel import MeshRunner
 from ..parallel.pipeline import DecodePrefetcher, HostStagingRing
 from ..parallel.mesh import enable_compilation_cache
@@ -285,6 +286,50 @@ class Extractor(abc.ABC):
             return self._decode_pool.get(video_path)
         return self._open_inline(video_path)
 
+    # auto-segmentation thresholds (--decode_segments 0): a video is worth
+    # splitting only when its decode time plausibly dominates a pool slot —
+    # proxied by source length — and each resulting segment amortizes its
+    # seek + thread cost over a meaningful run of frames
+    AUTO_MIN_SOURCE_FRAMES = 256
+    AUTO_MIN_SEGMENT_FRAMES = 96
+
+    def _plan_inline(self, video_path: str, max_segments: int):
+        """Segment planner handed to the decode pool (``set_segmenter``).
+
+        Returns None (decode sequentially) unless segmentation is both
+        enabled and worthwhile. Never raises: a probe failure here falls
+        back to the sequential open, which classifies the container with
+        full per-video fault attribution.
+        """
+        cfg = self.cfg
+        if cfg.decode_segments == 1 or max_segments < 2:
+            return None
+        if (cfg.extraction_fps is not None and cfg.use_ffmpeg != "never"
+                and ffmpeg_io.have_ffmpeg()):
+            # the ffmpeg re-encode resample path decodes a different
+            # (re-encoded) container — its parity anchor is the sequential
+            # re-encode, so it is never segmented
+            return None
+        try:
+            meta = probe_video(video_path)
+        except Exception:  # noqa: BLE001 — fault-barrier: the real open classifies
+            return None
+        if cfg.decode_segments:
+            limit = min(cfg.decode_segments, max_segments)
+            min_frames = 2
+        else:
+            if meta.frame_count < self.AUTO_MIN_SOURCE_FRAMES:
+                return None
+            limit = max_segments
+            min_frames = self.AUTO_MIN_SEGMENT_FRAMES
+        return plan_segments(meta, limit, extraction_fps=cfg.extraction_fps,
+                             min_segment_frames=min_frames)
+
+    def _open_segment_inline(self, plan, index: int):
+        """Decode one planned segment with this model's host transform."""
+        return open_video_segment(plan, index, transform=self._host_transform,
+                                  seek=self.cfg.segment_seek)
+
     # --- observability hooks (no-ops unless metrics are enabled) ---
 
     def _open_telemetry(self) -> None:
@@ -453,6 +498,8 @@ class Extractor(abc.ABC):
         if workers > 1 and self.uses_frame_stream:
             self._decode_pool = DecodePrefetcher(self._open_inline, workers,
                                                  journal=self._journal)
+            self._decode_pool.set_segmenter(self._plan_inline,
+                                            self._open_segment_inline)
         elif workers > 1:
             print(f"--decode_workers ignored: {self.feature_type} does not "
                   "consume the frame stream (whole-video / audio decode)")
@@ -1283,6 +1330,8 @@ class MultiModelSessions:
                 model=primary.feature_type)}
         if primary._decode_pool is not None and len(self.models) > 1:
             primary._decode_pool.set_opener(self._open_routed)
+            primary._decode_pool.set_segmenter(self._plan_routed,
+                                               self._open_segment_routed)
 
     # --- lazy model construction ---------------------------------------------
 
@@ -1349,12 +1398,24 @@ class MultiModelSessions:
             self._pool = DecodePrefetcher(self._open_routed,
                                           self.primary._decode_workers,
                                           journal=self.primary._journal)
+            self._pool.set_segmenter(self._plan_routed,
+                                     self._open_segment_routed)
         return self._pool
 
     def _open_routed(self, path: str):
         """Pool opener: decode ``path`` with its owning model's transform."""
         ex = self._ex_for_path.get(path, self.primary)
         return ex._open_inline(path)
+
+    def _plan_routed(self, path: str, max_segments: int):
+        """Pool segment planner: route to the path's owning model's policy."""
+        ex = self._ex_for_path.get(path, self.primary)
+        return ex._plan_inline(path, max_segments)
+
+    def _open_segment_routed(self, plan, index: int):
+        """Pool segment opener: the plan's source path names the owner."""
+        ex = self._ex_for_path.get(plan.source_meta.path, self.primary)
+        return ex._open_segment_inline(plan, index)
 
     def schedule_decode(self, path: str, model: str) -> None:
         """Prefetch-hint ``path`` on the shared pool under its model's

@@ -19,7 +19,9 @@ import os
 import pathlib
 import shutil
 import subprocess
-from typing import Sequence, Tuple
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
 
 from ..reliability import FfmpegError, fault_point
 
@@ -59,17 +61,98 @@ def _run_checked(cmd: Sequence[str], src_path: str, out_path: str) -> None:
     except OSError as e:
         raise FfmpegError(f"could not spawn ffmpeg for {src_path}: {e}") from e
     if proc.returncode != 0:
-        stderr = (proc.stderr or "").strip()
-        tail = stderr.splitlines()[-3:]
-        err = FfmpegError(
-            f"ffmpeg exited {proc.returncode} for {src_path}"
-            + (": " + " | ".join(tail) if tail else "")
-        )
-        if proc.returncode > 0 and any(m in stderr for m in _PERMANENT_STDERR_MARKERS):
-            err.transient = False  # the bytes will not improve; do not retry
-        raise err
+        raise _classified_exit_error(proc.returncode, proc.stderr or "", src_path)
     if not os.path.exists(out_path) or os.path.getsize(out_path) == 0:
         raise FfmpegError(f"ffmpeg exited 0 but produced no output at {out_path}")
+
+
+def _classified_exit_error(returncode: int, stderr: str, src_path: str) -> FfmpegError:
+    """Nonzero-exit taxonomy shared by the batch runner and the segment streamer."""
+    stderr = stderr.strip()
+    tail = stderr.splitlines()[-3:]
+    err = FfmpegError(
+        f"ffmpeg exited {returncode} for {src_path}"
+        + (": " + " | ".join(tail) if tail else "")
+    )
+    if returncode > 0 and any(m in stderr for m in _PERMANENT_STDERR_MARKERS):
+        err.transient = False  # the bytes will not improve; do not retry
+    return err
+
+
+def segment_frames(
+    video_path: str,
+    start_frame: int,
+    frame_count: Optional[int],
+    fps: float,
+    width: int,
+    height: int,
+) -> Iterator[np.ndarray]:
+    """Fast-seek decode of frames ``[start_frame, start_frame+frame_count)`` as RGB.
+
+    ``-ss`` placed BEFORE ``-i`` is ffmpeg's fast seek: the demuxer jumps to the
+    nearest seek point (keyframe) at or before the target timestamp, then the
+    decoder drops the lead-in frames between that keyframe and the target
+    (``accurate_seek``, on by default) — so landing is frame-exact without
+    decoding the whole prefix. Seeking to half a frame interval before the
+    target frame's pts keeps rounding from swallowing the target frame itself
+    on constant-frame-rate streams. ``frame_count=None`` reads to EOF.
+
+    Yields ``(height, width, 3)`` uint8 RGB arrays streamed off a rawvideo
+    pipe (no disk round-trip). Failures raise :class:`FfmpegError` with the
+    same input-vs-environment taxonomy as the re-encode path.
+    """
+    if not have_ffmpeg():
+        raise RuntimeError(
+            "ffmpeg is not installed; segment decode must use the cv2 seek "
+            "backend on this host (segment_seek='cv2' or 'auto')"
+        )
+    if fps <= 0:
+        raise ValueError(f"segment_frames needs a positive fps, got {fps}")
+    fault_point("ffmpeg", video_path)
+    cmd = [which_ffmpeg(), "-hide_banner", "-loglevel", "error", "-nostdin"]
+    if start_frame > 0:
+        cmd += ["-ss", f"{max(0.0, (start_frame - 0.5) / fps):.6f}"]
+    cmd += ["-i", video_path]
+    if frame_count is not None:
+        cmd += ["-frames:v", str(frame_count)]
+    cmd += ["-f", "rawvideo", "-pix_fmt", "rgb24", "pipe:1"]
+    frame_bytes = width * height * 3
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except OSError as e:
+        raise FfmpegError(f"could not spawn ffmpeg for {video_path}: {e}") from e
+    try:
+        got = 0
+        while frame_count is None or got < frame_count:
+            buf = proc.stdout.read(frame_bytes)
+            while 0 < len(buf) < frame_bytes:
+                chunk = proc.stdout.read(frame_bytes - len(buf))
+                if not chunk:
+                    break
+                buf += chunk
+            if len(buf) < frame_bytes:
+                # short read: EOF (fine when streaming to EOF) or a dead child
+                stderr = proc.stderr.read().decode(errors="replace")
+                rc = proc.wait()
+                if rc != 0:
+                    raise _classified_exit_error(rc, stderr, video_path)
+                if frame_count is not None:
+                    raise FfmpegError(
+                        f"{video_path}: segment [{start_frame}, "
+                        f"{start_frame + frame_count}) underran after {got} "
+                        f"frames (container frame count unreliable; rerun "
+                        f"with --decode_segments 1)"
+                    )
+                return
+            yield np.frombuffer(buf, np.uint8).reshape(height, width, 3)
+            got += 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+        proc.stderr.close()
+        proc.wait()
 
 
 def reencode_video_with_diff_fps(video_path: str, tmp_path: str, extraction_fps: int) -> str:

@@ -15,8 +15,8 @@ from __future__ import annotations
 
 import os
 import sys
-from dataclasses import dataclass
-from typing import Callable, Iterator, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import cv2
 import numpy as np
@@ -251,6 +251,288 @@ def open_video(
                 os.remove(reencoded)
 
     return meta, _iter()
+
+
+# ---------------------------------------------------------------------------
+# Segmented intra-video decode
+#
+# A long video decoded as ONE sequential cv2 stream caps throughput at
+# single-stream decode speed even when the rest of the decode pool idles.
+# plan_segments() splits the source frame range into seek-aligned segments;
+# open_video_segment() decodes one segment frame-exact so the concatenation of
+# all segments is byte-identical to open_video()'s sequential stream — both the
+# raw path and the native fps-resample path (per-segment slot boundaries are
+# pure arithmetic over resample_slots, so resample state never crosses a
+# segment boundary). The ffmpeg RE-ENCODE resample path is never segmented:
+# it decodes a different (re-encoded) container whose parity anchor is the
+# sequential re-encode itself.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SegmentPlan:
+    """A seek-aligned split of one video into concurrently decodable segments.
+
+    ``meta`` is the whole-video output meta (identical to what
+    :func:`open_video` would return for the same knobs); ``bounds`` partitions
+    the SOURCE frame index range ``[0, source_meta.frame_count)``.
+    """
+
+    source_meta: VideoMeta
+    meta: VideoMeta
+    extraction_fps: Optional[float]
+    min_segment_frames: int
+    bounds: List[Tuple[int, int]] = field(default_factory=list)
+
+    def narrow(self, max_segments: int) -> Optional["SegmentPlan"]:
+        """Re-slice for fewer permits than originally planned (or None)."""
+        return plan_segments(
+            self.source_meta, max_segments,
+            extraction_fps=self.extraction_fps,
+            min_segment_frames=self.min_segment_frames,
+        )
+
+
+def plan_segments(
+    meta: VideoMeta,
+    max_segments: int,
+    extraction_fps: Optional[float] = None,
+    min_segment_frames: int = 2,
+) -> Optional[SegmentPlan]:
+    """Split ``meta``'s frame range into ≤ ``max_segments`` near-equal segments.
+
+    Returns None when the video is too short to split (every segment must hold
+    at least ``min_segment_frames`` source frames) or the header metadata is
+    too degenerate to seek against. The header ``frame_count`` may undercount
+    (the final segment reads to EOF and absorbs the surplus); a header that
+    OVERcounts fails the video with a classified stitch error at decode time —
+    the per-video fault barrier catches it like any other decode failure.
+    """
+    total = meta.frame_count
+    if total <= 0 or meta.fps <= 0 or meta.width <= 0 or meta.height <= 0:
+        return None
+    k = min(max_segments, total // max(1, min_segment_frames))
+    if k < 2:
+        return None
+    bounds = []
+    for j in range(k):
+        start = total * j // k
+        end = total * (j + 1) // k
+        bounds.append((start, end))
+    if extraction_fps is not None:
+        out_count = int(round(total * float(extraction_fps) / meta.fps))
+        out_fps = float(extraction_fps)
+    else:
+        out_count = total
+        out_fps = meta.fps
+    out_meta = VideoMeta(path=meta.path, fps=out_fps, frame_count=out_count,
+                         width=meta.width, height=meta.height)
+    return SegmentPlan(source_meta=meta, meta=out_meta,
+                       extraction_fps=(float(extraction_fps)
+                                       if extraction_fps is not None else None),
+                       min_segment_frames=min_segment_frames, bounds=bounds)
+
+
+def _seeked_capture(path: str, start: int) -> Tuple[Optional[cv2.VideoCapture], int]:
+    """Open ``path`` positioned at/before source frame ``start``.
+
+    Returns ``(cap, lead_in)`` where ``lead_in`` frames must be decoded and
+    dropped before the target (keyframe snap), or ``(None, 0)`` when the
+    backend's seek overshot or reported garbage — the caller then falls back
+    to the ffmpeg fast-seek streamer or an exact decode-and-drop rescan. The
+    same decoder as sequential decode produces the segment's pixels, which is
+    what makes stitched output byte-identical by construction.
+    """
+    cap = cv2.VideoCapture(path)
+    if not cap.isOpened():
+        cap.release()
+        raise DecodeError(f"{path}: cannot open container (corrupt or unsupported)")
+    if start <= 0:
+        return cap, 0
+    cap.set(cv2.CAP_PROP_POS_FRAMES, float(start))
+    landed = int(cap.get(cv2.CAP_PROP_POS_FRAMES))
+    if 0 <= landed <= start:
+        # landed == start: frame-exact seek; landed < start: the backend
+        # snapped to a seek point (keyframe) — decode the lead-in and drop it
+        return cap, start - landed
+    cap.release()
+    return None, 0
+
+
+def _segment_source_frames(
+    cap: cv2.VideoCapture, lead_in: int, count: Optional[int],
+    first_segment: bool, path: str, start: int,
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """cv2 frames of one segment: drop ``lead_in``, then yield exactly ``count``.
+
+    Segment 0 keeps :func:`_raw_frames`'s one-missing-first-frame tolerance
+    (the workaround is a decoder open hiccup, not a content property — it can
+    only happen at the true start of the stream); middle segments are strict:
+    an early EOF means the container header lied about its frame count, which
+    breaks the stitch invariant, so it raises instead of silently yielding a
+    short (non-parity) stream. ``count=None`` (final segment) reads to EOF.
+    """
+    try:
+        for _ in range(lead_in):
+            ok, _bgr = cap.read()
+            if not ok:
+                raise DecodeError(
+                    f"{path}: EOF during seek lead-in before frame {start} "
+                    f"(container frame count unreliable; rerun with "
+                    f"--decode_segments 1)"
+                )
+        got = 0
+        first_attempt = first_segment
+        while count is None or got < count:
+            ok, bgr = cap.read()
+            if first_attempt:
+                first_attempt = False
+                if ok is False:
+                    continue
+            if not ok:
+                if count is not None:
+                    raise DecodeError(
+                        f"{path}: segment [{start}, {start + count}) underran "
+                        f"after {got} frames (container frame count "
+                        f"unreliable; rerun with --decode_segments 1)"
+                    )
+                break
+            yield cv2.cvtColor(bgr, cv2.COLOR_BGR2RGB), cap.get(cv2.CAP_PROP_POS_MSEC)
+            got += 1
+    finally:
+        cap.release()
+
+
+def _segment_resampled(
+    frames: Iterator[Tuple[np.ndarray, float]],
+    start: int,
+    src_fps: float,
+    dst_fps: float,
+    final_segment: bool,
+    end: int,
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """:func:`_resampled_frames` semantics restricted to source ``[start, end)``.
+
+    The sequential resampler's only cross-frame state entering source index
+    ``i`` is ``(next_slot, prev) = (resample_slots(i-1), frame[i-1])`` — and a
+    segment's FIRST frame needs no ``prev`` because slots strictly below
+    ``resample_slots(start)`` were flushed by the previous segment. Initial
+    ``next_slot`` is therefore pure arithmetic. Tail: a middle segment flushes
+    its last frame into slots up to ``resample_slots(end)`` (exactly what the
+    sequential loop does when processing frame ``end``); the final segment
+    emits its last frame ONCE (the sequential EOF flush).
+    """
+    next_slot = resample_slots(start, src_fps, dst_fps) if start > 0 else 0
+    prev: Optional[np.ndarray] = None
+    n = 0
+    for off, (rgb, _pos) in enumerate(frames):
+        slot = resample_slots(start + off, src_fps, dst_fps)
+        while prev is not None and next_slot < slot:
+            yield prev.copy(), (next_slot + 1) / dst_fps * 1000.0
+            next_slot += 1
+        prev = rgb
+        n += 1
+    if prev is None:
+        return
+    if final_segment:
+        yield prev.copy(), (next_slot + 1) / dst_fps * 1000.0
+        return
+    end_slot = resample_slots(end, src_fps, dst_fps)
+    while next_slot < end_slot:
+        yield prev.copy(), (next_slot + 1) / dst_fps * 1000.0
+        next_slot += 1
+
+
+def _require_nonempty(
+    frames: Iterator[Tuple[np.ndarray, float]], path: str, start: int,
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """Fail a final segment that finds EOF already behind its start frame.
+
+    Sequential decode would have emitted its EOF flush from an earlier frame;
+    a silently empty tail segment would stitch into a non-parity stream, so
+    the header overcount is surfaced as a classified stitch error instead.
+    """
+    n = 0
+    for item in frames:
+        n += 1
+        yield item
+    if n == 0:
+        raise DecodeError(
+            f"{path}: final segment starting at frame {start} found no frames "
+            f"(container frame count unreliable; rerun with --decode_segments 1)"
+        )
+
+
+def open_video_segment(
+    plan: SegmentPlan,
+    index: int,
+    transform: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+    seek: str = "auto",
+) -> Iterator[Tuple[np.ndarray, float]]:
+    """Frames of segment ``index`` of ``plan``, stitchable byte-exact.
+
+    Chaining ``open_video_segment(plan, 0) .. open_video_segment(plan, k-1)``
+    yields the same (frame, timestamp) stream as sequential
+    :func:`open_video` with the same ``extraction_fps``/``transform`` (native
+    resample path). Seek backend:
+
+    - ``auto``/``cv2`` — ``CAP_PROP_POS_FRAMES`` seek with readback
+      verification; a keyframe snap (landed short) decodes and drops the
+      lead-in. Same decoder as sequential decode, so parity holds by
+      construction.
+    - ``auto`` falls back to the ffmpeg ``-ss`` fast-seek rawvideo streamer
+      when cv2's seek overshoots/misreports AND the stream is fps-resampled
+      (there timestamps are slot arithmetic; the raw path needs cv2's
+      container ``POS_MSEC``), else to an exact decode-and-drop rescan.
+    - ``ffmpeg`` forces the streamer for non-first segments; raw-path
+      timestamps are then synthesized as ``(i+1)/fps`` — exact for
+      constant-frame-rate containers only.
+    """
+    if seek not in ("auto", "ffmpeg", "cv2"):
+        raise ValueError(f"seek must be 'auto'|'ffmpeg'|'cv2', got {seek!r}")
+    if not 0 <= index < len(plan.bounds):
+        raise ValueError(f"segment index {index} outside plan of {len(plan.bounds)}")
+    src = plan.source_meta
+    start, end = plan.bounds[index]
+    final_segment = index == len(plan.bounds) - 1
+    count = None if final_segment else end - start
+    fault_point("decode_segment", f"{src.path}#seg{index}")
+
+    use_ffmpeg_seek = seek == "ffmpeg" and start > 0
+    raw: Optional[Iterator[Tuple[np.ndarray, float]]] = None
+    if not use_ffmpeg_seek:
+        cap, lead_in = _seeked_capture(src.path, start)
+        if cap is None:
+            # cv2 cannot land on this container; resampled streams ignore the
+            # container timestamp, so ffmpeg's fast seek is safe there
+            if plan.extraction_fps is not None and ffmpeg_io.have_ffmpeg() and seek == "auto":
+                use_ffmpeg_seek = True
+            else:
+                cap = cv2.VideoCapture(src.path)
+                if not cap.isOpened():
+                    cap.release()
+                    raise DecodeError(
+                        f"{src.path}: cannot open container (corrupt or unsupported)")
+                lead_in = start  # exact O(start) decode-and-drop rescan
+        if cap is not None:
+            raw = _segment_source_frames(cap, lead_in, count, index == 0,
+                                         src.path, start)
+    if use_ffmpeg_seek:
+        stream = ffmpeg_io.segment_frames(
+            src.path, start, count, src.fps, src.width, src.height)
+        raw = ((rgb, (start + off + 1) / src.fps * 1000.0)
+               for off, rgb in enumerate(stream))
+
+    if final_segment and start > 0:
+        raw = _require_nonempty(raw, src.path, start)
+    if plan.extraction_fps is not None:
+        frames = _segment_resampled(raw, start, src.fps, plan.extraction_fps,
+                                    final_segment, end)
+    else:
+        frames = raw
+    if transform is None:
+        return frames
+    return ((transform(rgb), pos) for rgb, pos in frames)
 
 
 def decode_all(video_path: str, **kw) -> Tuple[VideoMeta, np.ndarray, np.ndarray]:

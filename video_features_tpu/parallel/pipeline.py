@@ -149,6 +149,19 @@ class DecodePrefetcher:
         self._workers = workers
         self._resize_lock = threading.Lock()
         self._debt = 0
+        # segmented intra-video decode (io/video.py plan_segments): a long
+        # video may occupy several permits, one per segment worker. Extras
+        # beyond the video's baseline permit are reserved NON-blockingly at
+        # schedule time under the invariant extras ≤ free − pending_baselines,
+        # so a segment can never consume the permit an already-scheduled
+        # video's baseline worker is entitled to (that blocking acquire is
+        # today's liveness guarantee and stays untouched).
+        self._planner = None  # optional (path, max_segments) -> SegmentPlan
+        self._segment_open = None  # optional (plan, index) -> frames iter
+        self._busy = 0  # permits acquired or reserved
+        self._pending_baselines = 0  # scheduled slots whose worker has not acquired yet
+        self._videos_segmented = 0  # videos decoded as >1 segment (stats)
+        self._segments_decoded = 0  # segment workers finished clean (stats)
 
     @property
     def workers(self) -> int:
@@ -164,6 +177,33 @@ class DecodePrefetcher:
         transform. Must be called before any :meth:`schedule` whose decode
         should route — workers read the opener at decode start."""
         self._open = open_fn
+
+    def set_segmenter(self, planner: Callable, open_segment: Callable) -> None:
+        """Enable segmented intra-video decode through this pool.
+
+        ``planner(path, max_segments) -> SegmentPlan | None`` decides whether
+        (and how finely) to split a video — None means decode sequentially.
+        ``open_segment(plan, index) -> frames_iter`` decodes one segment
+        (``io.video.open_video_segment`` with the extractor's transform).
+        Like :meth:`set_opener`, the multi-model layer reroutes both per path.
+        """
+        self._planner = planner
+        self._segment_open = open_segment
+
+    def spare_permits(self) -> int:
+        """Permits neither held by a worker nor owed to a scheduled video.
+
+        This is the headroom segmentation may consume, and the signal the
+        autoscaler reads to prefer segmenting the current video over growing
+        the pool (idle permits mean width is not the bottleneck).
+        """
+        with self._resize_lock:
+            return max(0, self._workers - self._busy - self._pending_baselines)
+
+    def segment_stats(self) -> Tuple[int, int]:
+        """(videos decoded segmented, segment workers completed clean)."""
+        with self._resize_lock:
+            return self._videos_segmented, self._segments_decoded
 
     def resize(self, workers: int) -> None:
         """Grow or shrink the concurrent-decode budget without a restart.
@@ -190,39 +230,169 @@ class DecodePrefetcher:
 
     def _release_permit(self) -> None:
         with self._resize_lock:
+            self._busy -= 1
             if self._debt:
                 self._debt -= 1
             else:
                 self._sem.release()
 
-    def schedule(self, path: str) -> None:
-        """Start decoding ``path`` in the background (no-op if scheduled)."""
-        if path in self._slots or path in self._handed or self._stop.is_set():
-            return
-        self._threads = [t for t in self._threads if t.is_alive()]
+    def _acquire_baseline(self) -> None:
+        """Blocking acquire of a scheduled video's one guaranteed permit."""
+        self._sem.acquire()  # at most `workers` decode streams concurrently
+        with self._resize_lock:
+            self._busy += 1
+            self._pending_baselines -= 1
+
+    def _reserve_permits(self, want: int) -> int:
+        """Non-blockingly reserve up to ``want`` SPARE permits for segments.
+
+        Never takes a permit a pending baseline worker is entitled to — a
+        segmented video only forms when the WHOLE split (all k workers) fits
+        in genuinely idle headroom, so every earlier-scheduled video keeps
+        its one-permit entitlement by counting and the consumer draining
+        videos in schedule order can always make progress (deadlock-free:
+        permit holders are only ever workers of videos at or before the
+        consumer's cursor, or of videos some independent loop is draining).
+        """
+        got = 0
+        with self._resize_lock:
+            spare = self._workers - self._busy - self._pending_baselines
+            while got < min(want, max(0, spare)):
+                if not self._sem.acquire(blocking=False):
+                    break
+                got += 1
+            self._busy += got
+        return got
+
+    def _new_slot(self, maxsize: int, max_bytes: int) -> dict:
         slot = {
-            "q": queue.Queue(maxsize=self._max),
+            "q": queue.Queue(maxsize=maxsize),
             "meta": None,
             "err": None,
             "bytes": 0,  # buffered payload bytes (max_buffered_bytes bound)
+            # per-slot share of the byte budget: a segmented video's k slots
+            # split the video's budget so its TOTAL buffered payload honors
+            # the same bound as an unsegmented decode
+            "max_bytes": max_bytes,
             # guards the bytes counter (vftlint GUARDED_BY: slot['bytes']
             # under the 'slot' lock)
             "lock": threading.Lock(),
             "ready": threading.Event(),
             "stop": threading.Event(),  # per-video cancel (release())
         }
+        return slot
+
+    @staticmethod
+    def _group_slots(slot: dict) -> List[dict]:
+        """The per-queue slots behind one scheduled path (1 or k segments)."""
+        return slot["segments"] if "segments" in slot else [slot]
+
+    def schedule(self, path: str) -> None:
+        """Start decoding ``path`` in the background (no-op if scheduled).
+
+        When a segmenter is installed (:meth:`set_segmenter`) and spare
+        permits exist, the video may be split into seek-aligned segments
+        decoded concurrently — planning runs on the calling thread (header
+        probe only) and any planner failure falls back to sequential decode:
+        scheduling never raises, the real open classifies bad containers.
+        """
+        if path in self._slots or path in self._handed or self._stop.is_set():
+            return
+        self._threads = [t for t in self._threads if t.is_alive()]
+        plan = self._plan_for(path)
+        if plan is not None and self._schedule_segments(path, plan):
+            return
+        self._schedule_single(path)
+
+    def _schedule_single(self, path: str) -> None:
+        slot = self._new_slot(self._max, self._max_bytes)
         self._slots[path] = slot
-        t = threading.Thread(target=self._worker, args=(path, slot), daemon=True)
+        with self._resize_lock:
+            self._pending_baselines += 1
+        t = threading.Thread(
+            target=self._pump,
+            args=(path, slot, lambda: self._open(path), False, None, None),
+            daemon=True)
         self._threads.append(t)
         t.start()
 
-    def _worker(self, path: str, slot: dict) -> None:
+    def _plan_for(self, path: str):
+        if self._planner is None or self._segment_open is None:
+            return None
+        with self._resize_lock:
+            spare = self._workers - self._busy - self._pending_baselines
+        if spare < 2:
+            return None  # a split needs at least two wholly-idle permits
+        try:
+            plan = self._planner(path, spare)
+        except Exception:  # noqa: BLE001 — fault-barrier: planning must never fail a video
+            return None
+        if plan is None or len(plan.bounds) < 2:
+            return None
+        return plan
+
+    def _schedule_segments(self, path: str, plan) -> bool:
+        # every segment worker's permit — INCLUDING segment 0's — is secured
+        # up front: a segmented video must never block on the baseline
+        # semaphore while its own sibling segments hold permits waiting for
+        # the consumer to reach them (that cycle is a deadlock)
+        got = self._reserve_permits(len(plan.bounds))
+        if got < 2:
+            for _ in range(got):
+                self._release_permit()
+            return False  # the headroom evaporated since planning
+        if got < len(plan.bounds):
+            plan = plan.narrow(got)
+            if plan is None or len(plan.bounds) < 2 or len(plan.bounds) > got:
+                for _ in range(got):
+                    self._release_permit()
+                return False
+            for _ in range(got - len(plan.bounds)):
+                self._release_permit()
+                got -= 1
+        k = len(plan.bounds)
+        subs = [self._new_slot(max(1, self._max // k),
+                               max(1, self._max_bytes // k)) for _ in range(k)]
+        group = {"segments": subs, "meta": plan.meta, "plan": plan}
+        self._slots[path] = group
+        with self._resize_lock:
+            self._videos_segmented += 1  # stats counter (segment_stats)
+        for j, sub in enumerate(subs):
+            t = threading.Thread(
+                target=self._pump,
+                args=(path, sub,
+                      (lambda p=plan, i=j: (p.meta, self._segment_open(p, i))),
+                      True, j, k),
+                daemon=True)
+            self._threads.append(t)
+            t.start()
+        return True
+
+    def _pump(self, path: str, slot: dict, produce: Callable, reserved: bool,
+              segment: Optional[int], segments: Optional[int]) -> None:
+        """Worker body shared by whole-video and segment decode streams.
+
+        ``produce() -> (meta, frames_iter)``; ``reserved`` workers arrived
+        with a permit pre-reserved at schedule time (segmented videos secure
+        every segment's permit up front), others perform the normal blocking
+        baseline acquire. ``segment``/``segments`` tag a segment stream's
+        journal span and completion counter.
+        """
+
         def stopped() -> bool:
             return self._stop.is_set() or slot["stop"].is_set()
 
-        self._sem.acquire()  # at most `workers` videos decoding concurrently
-        sid = (self._journal.begin("decode", video=path)
-               if self._journal is not None else None)
+        if not reserved:
+            self._acquire_baseline()
+        # journal 'decode' span: full occupancy of this decode slot
+        sid = None
+        if self._journal is not None:
+            if segment is None:
+                sid = self._journal.begin("decode", video=path)
+            else:
+                sid = self._journal.begin("decode", video=path,
+                                          segment=segment, segments=segments)
+        clean = False
         try:
             try:
                 if stopped():
@@ -231,7 +401,7 @@ class DecodePrefetcher:
                 # open_fn) must still surface a classified error at consume
                 # time instead of deadlocking the drain — tests prove it
                 fault_point("pool_worker", path)
-                meta, frames = self._open(path)
+                meta, frames = produce()
                 slot["meta"] = meta  # thread-shared-state: published by the ready Event set below
                 slot["ready"].set()
                 for item in frames:
@@ -243,7 +413,7 @@ class DecodePrefetcher:
                     while not stopped():
                         with slot["lock"]:
                             fits = (slot["bytes"] == 0
-                                    or slot["bytes"] + nbytes <= self._max_bytes)
+                                    or slot["bytes"] + nbytes <= slot["max_bytes"])
                         if fits:
                             break
                         time.sleep(0.05)
@@ -259,6 +429,7 @@ class DecodePrefetcher:
                             continue
                     if stopped():
                         return
+                clean = not stopped()
             except Exception as e:  # noqa: BLE001 — fault-barrier: re-raised classified at consume time
                 slot["err"] = e  # thread-shared-state: published by the ready Event / _DONE sentinel in finally
             finally:
@@ -271,7 +442,14 @@ class DecodePrefetcher:
                         continue
         finally:
             if sid is not None:
-                self._journal.end("decode", sid, video=path)
+                if segment is None:
+                    self._journal.end("decode", sid, video=path)
+                else:
+                    self._journal.end("decode", sid, video=path,
+                                      segment=segment, segments=segments)
+            if clean and segment is not None:
+                with self._resize_lock:
+                    self._segments_decoded += 1  # thread-shared-state: guarded by the 'resize' lock (stats counter, segment_stats reads under it)
             # a shrink may have pre-claimed this permit as debt; the helper
             # settles debt before returning the permit to the pool
             self._release_permit()
@@ -287,54 +465,71 @@ class DecodePrefetcher:
         if slot is None:
             return self._open(path)
         self._handed[path] = slot
+        if "segments" in slot:
+            # segmented video: in-order reassembly — stream segment j's queue
+            # to the consumer while segments j+1..k-1 keep decoding into
+            # theirs. A poisoned segment's error surfaces mid-generator,
+            # exactly where a sequential decode error would.
+            def reassemble() -> Iterator[Tuple[np.ndarray, float]]:
+                for sub in slot["segments"]:
+                    for item in self._drain(sub):
+                        yield item
+
+            return slot["meta"], reassemble()
         slot["ready"].wait()
         if slot["err"] is not None and slot["meta"] is None:
             raise slot["err"]
+        return slot["meta"], self._drain(slot)
 
-        def drain() -> Iterator[Tuple[np.ndarray, float]]:
-            while True:
-                try:
-                    item = slot["q"].get(timeout=0.2)
-                except queue.Empty:
-                    # release()/shutdown() with a full queue can drop their
-                    # _DONE sentinel while the stopped worker never enqueues
-                    # one — without this check a late consumer blocks forever.
-                    # A stored worker error must still surface on this exit
-                    # path (the dropped sentinel would otherwise swallow it).
-                    if slot["stop"].is_set() or self._stop.is_set():
-                        if slot["err"] is not None:
-                            raise slot["err"]
-                        return
-                    continue
-                if item is self._DONE:
+    def _drain(self, slot: dict) -> Iterator[Tuple[np.ndarray, float]]:
+        while True:
+            try:
+                item = slot["q"].get(timeout=0.2)
+            except queue.Empty:
+                # release()/shutdown() with a full queue can drop their
+                # _DONE sentinel while the stopped worker never enqueues
+                # one — without this check a late consumer blocks forever.
+                # A stored worker error must still surface on this exit
+                # path (the dropped sentinel would otherwise swallow it).
+                if slot["stop"].is_set() or self._stop.is_set():
                     if slot["err"] is not None:
                         raise slot["err"]
                     return
-                with slot["lock"]:
-                    # release the byte budget as soon as the item leaves the
-                    # buffer (once yielded it is the consumer's memory)
-                    slot["bytes"] -= _item_bytes(item)
-                yield item
-
-        return slot["meta"], drain()
+                continue
+            if item is self._DONE:
+                if slot["err"] is not None:
+                    raise slot["err"]
+                return
+            with slot["lock"]:
+                # release the byte budget as soon as the item leaves the
+                # buffer (once yielded it is the consumer's memory)
+                slot["bytes"] -= _item_bytes(item)
+            yield item
 
     def release(self, path: str) -> None:
-        """Cancel/forget a video's decode (no-op for finished or unknown ones)."""
+        """Cancel/forget a video's decode (no-op for finished or unknown ones).
+
+        For a segmented video the cancel fans out to EVERY segment worker —
+        each sub-slot gets its stop flag and a drain-unblocking sentinel.
+        """
         slot = self._handed.pop(path, None) or self._slots.pop(path, None)
-        if slot is not None:
-            slot["stop"].set()
+        if slot is None:
+            return
+        for sub in self._group_slots(slot):
+            sub["stop"].set()
             try:  # a consumer mid-drain must not hang on an exiting worker
-                slot["q"].put_nowait(self._DONE)
+                sub["q"].put_nowait(self._DONE)
             except queue.Full:
                 pass
 
     def shutdown(self) -> None:
         self._stop.set()
         for slot in list(self._slots.values()) + list(self._handed.values()):
-            try:  # unblock any drain() consumers
-                slot["q"].put_nowait(self._DONE)
-            except queue.Full:
-                pass  # consumer has items to drain before it can block
+            for sub in self._group_slots(slot):
+                try:  # unblock any drain() consumers
+                    sub["q"].put_nowait(self._DONE)
+                except queue.Full:
+                    pass  # consumer has items to drain before it can block
         for t in self._threads:
             t.join(timeout=2.0)
         self._slots.clear()

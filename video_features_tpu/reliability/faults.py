@@ -21,8 +21,11 @@ Spec grammar — rules separated by ``;``, fields by ``:``::
   unlimited. ``ffmpeg:raise::1`` fails exactly the first ffmpeg call — the
   canonical transient-then-success retry test.
 
-Declared sites: ``probe`` and ``decode`` (io/video.py), ``ffmpeg``
-(io/ffmpeg.py), ``save`` (io/output.py, between tmp-write and atomic rename),
+Declared sites: ``probe`` and ``decode`` (io/video.py), ``decode_segment``
+(io/video.py, fires per segment with key ``<path>#seg<index>`` so one poisoned
+segment of one video can be targeted), ``ffmpeg``
+(io/ffmpeg.py, also guards the segment fast-seek streamer), ``save``
+(io/output.py, between tmp-write and atomic rename),
 ``extract`` (extractors/base.py, wraps the whole per-video attempt),
 ``pool_worker`` (parallel/pipeline.py decode-worker body), ``device``
 (parallel/packer.py, just before a batch's device step dispatches), and the
@@ -56,6 +59,7 @@ ENV_VAR = "VFT_FAULTS"
 _SITE_ERRORS = {
     "probe": DecodeError,
     "decode": DecodeError,
+    "decode_segment": DecodeError,
     "pool_worker": DecodeError,
     "ffmpeg": FfmpegError,
     "extract": DeviceError,

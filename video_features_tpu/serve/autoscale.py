@@ -40,18 +40,29 @@ class DecodeAutoscaler:
 
     def decide(self, occupancy: float, decode_seconds: float,
                wall_seconds: float, current: int,
-               dispatched_slots: int = MIN_INTERVAL_SLOTS) -> int:
+               dispatched_slots: int = MIN_INTERVAL_SLOTS,
+               spare_permits: int = 0) -> int:
         """New pool size for the next interval.
 
         ``occupancy``/``decode_seconds``/``wall_seconds``/``dispatched_slots``
         are THIS interval's deltas, not run totals — an old starved interval
         must not keep growing a pool that already caught up.
+
+        ``spare_permits`` is the pool's CURRENT idle-permit headroom
+        (:meth:`..parallel.pipeline.DecodePrefetcher.spare_permits`). A
+        decode-starved interval with idle permits means width is not the
+        bottleneck — few long videos are pinning the pipeline at
+        single-stream decode speed — so the right move is letting segmented
+        decode spend the permits that already exist, not growing a pool
+        that cannot use the workers it has.
         """
         if wall_seconds <= 0 or dispatched_slots < MIN_INTERVAL_SLOTS:
             return current
         decode_fraction = decode_seconds / wall_seconds
         if (occupancy < STARVED_OCCUPANCY
                 and decode_fraction >= STARVED_DECODE_FRACTION):
+            if spare_permits > 0:
+                return current  # segment the current videos instead
             return min(current + 1, self.max_workers)
         if decode_fraction <= IDLE_DECODE_FRACTION:
             return max(current - 1, self.min_workers)

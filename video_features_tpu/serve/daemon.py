@@ -924,6 +924,10 @@ class ExtractionService:
         pool = self.sessions.decode_pool
         if self._autoscaler is None or pool is None:
             return
+        # read the pool's idle-permit headroom BEFORE taking the service
+        # lock: spare_permits() takes the pool's resize lock, and the
+        # declared lock order has no service→resize edge to lean on
+        spare = pool.spare_permits()
         with self._lock:
             now = time.perf_counter()
             decode = self.ex.clock.seconds.get("decode", 0.0)
@@ -935,13 +939,14 @@ class ExtractionService:
             current = pool.workers
             new = self._autoscaler.decide(occupancy, decode - d0, now - t0,
                                           current,
-                                          dispatched_slots=d_slots)
+                                          dispatched_slots=d_slots,
+                                          spare_permits=spare)
         if new != current:
             print(f"[serve] decode autoscale: {current} → {new} "
                   f"worker(s) (interval occupancy {occupancy:.1%}, decode "
                   f"{decode - d0:.2f}s of {now - t0:.2f}s)")
             self._emit("autoscale", workers_from=current, workers_to=new,
-                       occupancy=round(occupancy, 4))
+                       occupancy=round(occupancy, 4), spare_permits=spare)
             pool.resize(new)
             self.metrics.set_gauge("decode_workers", new)
 
@@ -1011,6 +1016,8 @@ class ExtractionService:
 
     def stats(self) -> dict:
         pool = self.sessions.decode_pool
+        seg_videos, seg_segments = (pool.segment_stats() if pool is not None
+                                    else (0, 0))
         # per-model rollup: packer occupancy by model × completion counters
         # (only models that saw traffic appear — lazily-built extractors)
         model_occ = self.packer.model_stats()
@@ -1084,6 +1091,12 @@ class ExtractionService:
                 "wal": (self._wal.stats() if self._wal is not None
                         else {"enabled": False}),
                 "decode_workers": pool.workers if pool is not None else 0,
+                # segmented intra-video decode (additive, no schema bump):
+                # videos split across permits and segment streams completed
+                "segmented_decode": {
+                    "videos": seg_videos,
+                    "segments": seg_segments,
+                },
                 "tenants": self.queue.stats(),
                 "breaker_open": list(self.breaker.open_tenants()),
                 # per-tenant × per-model latency distributions (p50/p95/p99
